@@ -1,0 +1,21 @@
+"""aiko_services_tpu: a TPU-native distributed actor / dataflow-pipeline
+framework with the capability set of Aiko Services (reference:
+github.com/geekscape/aiko_services, mounted at /root/reference).
+
+Control plane: actors, discovery (leader-elected Registrar), eventual-
+consistency shared state, leases, distributed logging -- over a pluggable
+message fabric (in-memory loopback or MQTT).
+
+Data plane: TPU-native.  Pipeline stages are placed on chips/submeshes of a
+``jax.sharding.Mesh``; frames carry ``jax.Array`` payloads; the ML elements
+(detection, LLM with paged KV-cache + continuous batching, speech) are
+JAX/XLA/Pallas implementations; long-context runs via ring-attention
+sequence parallelism over the mesh.
+"""
+
+__version__ = "0.1.0"
+
+from .utils import *          # noqa: F401,F403
+from .runtime import *        # noqa: F401,F403
+from .transport import *      # noqa: F401,F403
+from .services import *       # noqa: F401,F403

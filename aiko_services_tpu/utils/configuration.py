@@ -1,0 +1,80 @@
+"""Environment-variable configuration.
+
+Mirrors the reference's env-var config surface (reference:
+src/aiko_services/main/utilities/configuration.py:47-186) with the same
+variable names so deployments translate directly, plus TPU-specific knobs.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+__all__ = [
+    "get_namespace", "get_hostname", "get_pid",
+    "get_mqtt_configuration", "get_transport", "get_username",
+    "env_flag", "env_int", "env_float",
+]
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    value = os.environ.get(name)
+    if value is None:
+        return default
+    return value.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def get_namespace() -> str:
+    return os.environ.get("AIKO_NAMESPACE", "aiko")
+
+
+def get_hostname() -> str:
+    return os.environ.get("AIKO_HOSTNAME", socket.gethostname().split(".")[0])
+
+
+def get_pid() -> str:
+    return str(os.getpid())
+
+
+def get_username() -> str:
+    return (os.environ.get("AIKO_USERNAME")
+            or os.environ.get("USER") or os.environ.get("USERNAME") or "nobody")
+
+
+def get_transport() -> str:
+    """Which message transport the process runtime should create:
+    ``loopback`` (in-memory, default for tests / single host), ``mqtt``,
+    or ``castaway`` (null)."""
+    return os.environ.get("AIKO_TRANSPORT", "loopback").lower()
+
+
+def get_mqtt_configuration() -> dict:
+    host = os.environ.get("AIKO_MQTT_HOST", "localhost")
+    port = env_int("AIKO_MQTT_PORT", 1883)
+    tls = env_flag("AIKO_MQTT_TLS", False)
+    username = os.environ.get("AIKO_MQTT_USERNAME")
+    password = os.environ.get("AIKO_MQTT_PASSWORD")
+    return {"host": host, "port": port, "tls": tls,
+            "username": username, "password": password}
+
+
+def mqtt_broker_reachable(host: str, port: int, timeout: float = 1.0) -> bool:
+    try:
+        with socket.create_connection((host, port), timeout=timeout):
+            return True
+    except OSError:
+        return False

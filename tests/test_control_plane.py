@@ -1,0 +1,150 @@
+"""Control-plane integration tests over the in-memory loopback broker:
+registrar election, service registration, LWT reaping, EC share
+replication, remote proxies, discovery.  This is the offline multi-service
+harness the reference cannot provide (its null transport delivers nothing;
+reference tests skip registrar/share entirely -- SURVEY.md section 4)."""
+
+from conftest import run_until
+
+from aiko_services_tpu.runtime import ConnectionState
+from aiko_services_tpu.services import (
+    Actor, Registrar, ServiceFilter, ECConsumer, get_service_proxy,
+    do_command, do_request)
+from aiko_services_tpu.transport import get_broker
+
+
+class EchoActor(Actor):
+    PROTOCOL = "test/echo:0"
+
+    def __init__(self, name, runtime=None):
+        super().__init__(name, self.PROTOCOL, tags=["role=echo"],
+                         runtime=runtime)
+        self.calls = []
+
+    def hello(self, name):
+        self.calls.append(name)
+
+    def ask(self, response_topic, question):
+        self.runtime.message.publish(response_topic, "(item_count 1)")
+        self.runtime.message.publish(response_topic,
+                                     f"(response {question}!)")
+
+
+def test_registrar_election_and_registration(runtime):
+    registrar = Registrar(runtime=runtime, primary_search_timeout=0.05)
+    actor = EchoActor("echo_1", runtime=runtime)
+
+    assert run_until(
+        runtime,
+        lambda: (registrar.state == "primary"
+                 and runtime.connection.state == ConnectionState.REGISTRAR
+                 and registrar.registry.get(actor.topic_path) is not None),
+        timeout=5.0)
+    record = registrar.registry.get(actor.topic_path)
+    assert record.name == "echo_1"
+    assert record.protocol == EchoActor.PROTOCOL
+    assert "role=echo" in record.tags
+
+
+def test_second_registrar_becomes_secondary(runtime):
+    primary = Registrar("registrar_a", runtime=runtime,
+                        primary_search_timeout=0.05)
+    run_until(runtime, lambda: primary.state == "primary")
+    secondary = Registrar("registrar_b", runtime=runtime,
+                          primary_search_timeout=0.05)
+    assert run_until(runtime, lambda: secondary.state == "secondary")
+    assert primary.state == "primary"
+
+
+def test_lwt_reaps_dead_process_services(runtime):
+    registrar = Registrar(runtime=runtime, primary_search_timeout=0.05)
+    actor = EchoActor("echo_dead", runtime=runtime)
+    run_until(runtime,
+              lambda: registrar.registry.get(actor.topic_path) is not None)
+
+    # Simulate another process dying: its LWT "(absent)" fires on its
+    # process state topic.  Use a fake foreign process topic.
+    foreign = f"{runtime.namespace}/otherhost/999/1"
+    runtime.message.publish(
+        f"{registrar.topic_path}/in",
+        f"(add {foreign} ghost test/ghost:0 loopback nobody ())")
+    run_until(runtime,
+              lambda: registrar.registry.get(foreign) is not None)
+    get_broker().publish(f"{runtime.namespace}/otherhost/999/0/state",
+                         "(absent)")
+    assert run_until(runtime,
+                     lambda: registrar.registry.get(foreign) is None)
+    # Local process services survive.
+    assert registrar.registry.get(actor.topic_path) is not None
+
+
+def test_remote_proxy_invocation(runtime):
+    actor = EchoActor("echo_proxy", runtime=runtime)
+    proxy = get_service_proxy(runtime, actor.topic_path)
+    proxy.hello("world")
+    assert run_until(runtime, lambda: actor.calls == ["world"])
+
+
+def test_ec_share_replication(runtime):
+    producer_actor = EchoActor("echo_share", runtime=runtime)
+    cache = {}
+    consumer = ECConsumer(runtime, producer_actor.topic_path, cache,
+                          lease_time=60.0)
+    assert run_until(runtime, lambda: consumer.synced)
+    assert cache["name"] == "echo_share"
+    assert cache["lifecycle"] == "ready"
+
+    producer_actor.ec_producer.update("custom", "42")
+    assert run_until(runtime, lambda: cache.get("custom") == "42")
+
+    producer_actor.ec_producer.remove("custom")
+    assert run_until(runtime, lambda: "custom" not in cache)
+
+
+def test_ec_remote_update_changes_log_level(runtime):
+    actor = EchoActor("echo_loglevel", runtime=runtime)
+    runtime.message.publish(f"{actor.topic_path}/control",
+                            "(update log_level DEBUG)")
+    assert run_until(runtime,
+                     lambda: actor.share.get("log_level") == "DEBUG")
+
+
+def test_do_command_via_discovery(runtime):
+    registrar = Registrar(runtime=runtime, primary_search_timeout=0.05)
+    actor = EchoActor("echo_cmd", runtime=runtime)
+    do_command(runtime, EchoActor,
+               ServiceFilter(name="echo_cmd"),
+               lambda proxy: proxy.hello("discovered"))
+    assert run_until(runtime, lambda: actor.calls == ["discovered"])
+
+
+def test_do_request_response(runtime):
+    registrar = Registrar(runtime=runtime, primary_search_timeout=0.05)
+    actor = EchoActor("echo_req", runtime=runtime)
+    responses = []
+    do_request(runtime, EchoActor, ServiceFilter(name="echo_req"),
+               lambda proxy, response_topic: proxy.ask(response_topic,
+                                                       "ping"),
+               responses.append)
+    assert run_until(runtime, lambda: bool(responses))
+    assert responses[0] == [("response", ["ping!"])]
+
+
+def test_share_query_to_registrar(runtime):
+    """ServicesCache-level query: ask the registrar directory directly."""
+    registrar = Registrar(runtime=runtime, primary_search_timeout=0.05)
+    actor_a = EchoActor("query_a", runtime=runtime)
+    actor_b = EchoActor("query_b", runtime=runtime)
+    run_until(runtime,
+              lambda: registrar.registry.get(actor_b.topic_path) is not None)
+
+    got = []
+    response_topic = f"{runtime.topic_path_process}/testq"
+    runtime.add_message_handler(lambda t, p: got.append(p), response_topic)
+    runtime.message.publish(
+        f"{registrar.topic_path}/in",
+        f"(share {response_topic} * query_a * * * *)")
+    assert run_until(runtime,
+                     lambda: any("sync" in p for p in got))
+    adds = [p for p in got if p.startswith("(add")]
+    assert len(adds) == 1 and "query_a" in adds[0]

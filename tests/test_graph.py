@@ -1,0 +1,52 @@
+"""Graph parse/traverse tests (behavior parity with reference
+src/aiko_services/main/utilities/graph.py and the pipeline-graph
+name-mapping matrix in tests/unit/test_pipeline_graph.py)."""
+
+import pytest
+
+from aiko_services_tpu.utils import Graph, GraphError
+
+
+def test_linear():
+    graph = Graph.traverse(["(a b c)"])
+    assert [n.name for n in graph.get_path()] == ["a", "b", "c"]
+
+
+def test_diamond():
+    graph = Graph.traverse(["(a (b d) (c d))"])
+    path = [n.name for n in graph.get_path()]
+    assert path == ["a", "b", "d", "c"]
+    assert {s.name for s in graph.get_node("a").successors} == {"b", "c"}
+    assert [s.name for s in graph.get_node("b").successors] == ["d"]
+    assert [s.name for s in graph.get_node("c").successors] == ["d"]
+
+
+def test_single_node():
+    graph = Graph.traverse(["(a)"])
+    assert [n.name for n in graph.get_path()] == ["a"]
+
+
+def test_iterate_after():
+    graph = Graph.traverse(["(a b c d)"])
+    assert [n.name for n in graph.iterate_after("b")] == ["c", "d"]
+    assert [n.name for n in graph.iterate_after("d")] == []
+    with pytest.raises(GraphError):
+        graph.iterate_after("zz")
+
+
+def test_multiple_heads():
+    graph = Graph.traverse(["(a b)", "(x y)"])
+    assert [h.name for h in graph.heads] == ["a", "x"]
+    assert [n.name for n in graph.get_path("x")] == ["x", "y"]
+
+
+def test_predecessors():
+    graph = Graph.traverse(["(a (b d) (c d))"])
+    assert {n.name for n in graph.predecessors("d")} == {"b", "c"}
+
+
+def test_acyclic_validation():
+    graph = Graph.traverse(["(a b)"])
+    graph.get_node("b").add_successor(graph.get_node("a"))
+    with pytest.raises(GraphError):
+        graph.validate_acyclic()

@@ -1,0 +1,99 @@
+"""S-expression codec tests (behavior parity with reference
+src/aiko_services/main/utilities/parser.py round-trip cases)."""
+
+import pytest
+
+from aiko_services_tpu.utils import (generate, generate_value, parse,
+                                     parse_value, parse_number,
+                                     SExprError)
+
+
+def test_simple_command():
+    command, params = parse("(add topic name)")
+    assert command == "add"
+    assert params == ["topic", "name"]
+
+
+def test_empty_command():
+    command, params = parse("(sync)")
+    assert command == "sync"
+    assert params == []
+
+
+def test_nested_lists():
+    command, params = parse("(a (b c) d)")
+    assert command == "a"
+    assert params == [["b", "c"], "d"]
+
+
+def test_dictionary():
+    command, params = parse("(process_frame (stream_id: 1) (a: 0))")
+    assert command == "process_frame"
+    assert params == [{"stream_id": "1"}, {"a": "0"}]
+
+
+def test_nested_dictionary():
+    value = parse_value("(outer: (inner: 42) other: x)")
+    assert value == {"outer": {"inner": "42"}, "other": "x"}
+
+
+def test_quoted_strings():
+    command, params = parse('(say "hello world" plain)')
+    assert params == ["hello world", "plain"]
+
+
+def test_quoted_escape():
+    command, params = parse(r'(say "a \"quoted\" word")')
+    assert params == ['a "quoted" word']
+
+
+def test_length_prefixed_token():
+    # 11 raw chars including a space and parenthesis
+    text = '(blob 11:ab cd(ef) g tail)'
+    command, params = parse(text)
+    assert params == ["ab cd(ef) g", "tail"]
+
+
+def test_generate_roundtrip():
+    payload = generate("add", ["topic/path", "name", 3, 2.5, True,
+                               ["t1", "t2"], {"k": "v"}])
+    command, params = parse(payload)
+    assert command == "add"
+    assert params[0] == "topic/path"
+    assert params[2] == "3"
+    assert params[5] == ["t1", "t2"]
+    assert params[6] == {"k": "v"}
+
+
+def test_generate_quoting():
+    payload = generate("say", ["hello world"])
+    assert parse(payload)[1] == ["hello world"]
+
+
+def test_generate_special_chars_roundtrip():
+    nasty = 'line1\nline"2\\x'
+    payload = generate("blob", [nasty])
+    assert parse(payload)[1] == [nasty]
+
+
+def test_parse_number():
+    assert parse_number("42") == 42
+    assert parse_number("2.5") == 2.5
+    assert parse_number("true") is True
+    assert parse_number("false") is False
+    assert parse_number("nil") is None
+    assert parse_number("abc") == "abc"
+    assert parse_number("abc", 7) == 7
+
+
+def test_errors():
+    with pytest.raises(SExprError):
+        parse("(unterminated")
+    with pytest.raises(SExprError):
+        parse("(a) trailing")
+
+
+def test_bare_atom():
+    value, params = parse("atom")
+    assert value == "atom"
+    assert params == []
