@@ -19,3 +19,4 @@ from .utils import *          # noqa: F401,F403
 from .runtime import *        # noqa: F401,F403
 from .transport import *      # noqa: F401,F403
 from .services import *       # noqa: F401,F403
+from .pipeline import *       # noqa: F401,F403

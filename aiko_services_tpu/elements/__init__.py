@@ -1,0 +1,7 @@
+from .common import Mock, NoOp, Identity, Terminate
+from .scheme_file import DataSchemeFile
+from .text import (TextReadFile, TextWriteFile, TextTransform, TextSample,
+                   TextOutput)
+from .observe import Inspect, Metrics
+from .expression import Expression, AllOutputs, evaluate_expression
+from .control import Loop

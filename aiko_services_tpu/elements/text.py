@@ -1,0 +1,96 @@
+"""Text I/O elements (reference: src/aiko_services/elements/media/
+text_io.py): file read/write, sampling, case transforms.  TTY/socket
+variants live with the interactive tooling."""
+
+from __future__ import annotations
+
+import os
+
+from ..pipeline import (DataSource, DataTarget, PipelineElement,
+                        StreamEvent)
+from .scheme_file import DataSchemeFile
+
+__all__ = ["TextReadFile", "TextWriteFile", "TextTransform", "TextSample",
+           "TextOutput"]
+
+
+class TextReadFile(DataSource):
+    """Reads text file(s) named by ``data_sources``; emits one frame per
+    file with ``text`` (reference text_io.py:107-128)."""
+
+    def process_frame(self, stream, **inputs):
+        path = inputs.get("path")
+        try:
+            with open(path) as fh:
+                text = fh.read()
+        except OSError as error:
+            return StreamEvent.ERROR, {"diagnostic": str(error)}
+        return StreamEvent.OKAY, {"text": text, "path": path}
+
+
+class TextWriteFile(DataTarget):
+    """Writes ``text`` to the ``data_targets`` path; ``{}`` templates get
+    the frame index (reference text_io.py:280-333)."""
+
+    def process_frame(self, stream, text=None, **inputs):
+        scheme = self.scheme_for(stream)
+        if not isinstance(scheme, DataSchemeFile):
+            return StreamEvent.ERROR, {
+                "diagnostic": "TextWriteFile requires file:// targets"}
+        path = scheme.target_path(stream)
+        try:
+            with open(path, "a" if "{}" not in
+                      stream.variables["target_path"] else "w") as fh:
+                fh.write(str(text))
+                if not str(text).endswith(os.linesep):
+                    fh.write(os.linesep)
+        except OSError as error:
+            return StreamEvent.ERROR, {"diagnostic": str(error)}
+        return StreamEvent.OKAY, {"path": path}
+
+
+class TextTransform(PipelineElement):
+    """Case/strip transforms chosen by the ``transform`` parameter
+    (reference text_io.py:236-280)."""
+
+    TRANSFORMS = {
+        "lower": str.lower, "upper": str.upper, "title": str.title,
+        "strip": str.strip, "none": lambda t: t,
+    }
+
+    def process_frame(self, stream, text=None, **inputs):
+        name, _ = self.get_parameter("transform", "none")
+        transform = self.TRANSFORMS.get(str(name))
+        if transform is None:
+            return StreamEvent.ERROR, {
+                "diagnostic": f"unknown transform {name!r}"}
+        return StreamEvent.OKAY, {"text": transform(str(text))}
+
+
+class TextSample(PipelineElement):
+    """Passes every Nth frame, drops the rest (reference
+    text_io.py:220-236)."""
+
+    def start_stream(self, stream, stream_id):
+        stream.variables[f"{self.name}.count"] = 0
+        return StreamEvent.OKAY, {}
+
+    def process_frame(self, stream, text=None, **inputs):
+        rate, _ = self.get_parameter("sample_rate", 1)
+        count = stream.variables.get(f"{self.name}.count", 0)
+        stream.variables[f"{self.name}.count"] = count + 1
+        if count % int(rate):
+            return StreamEvent.DROP_FRAME, {}
+        return StreamEvent.OKAY, {"text": text}
+
+
+class TextOutput(PipelineElement):
+    """Collects text into ``pipeline.share`` and optionally prints --
+    tail element for tests/demos (reference text_io.py:89-107)."""
+
+    def process_frame(self, stream, text=None, **inputs):
+        collected = stream.variables.setdefault("text_output", [])
+        collected.append(text)
+        if self.get_parameter("print", False)[0]:
+            print(text)
+        return StreamEvent.OKAY, {"text": text}

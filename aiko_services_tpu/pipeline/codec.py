@@ -1,0 +1,56 @@
+"""Frame wire codec: swag values <-> S-expression-safe strings.
+
+Local (in-process) frames never touch this -- swag values including
+``jax.Array``s pass by reference.  Only frames crossing a process boundary
+on the *control* fabric are encoded: scalars/lists/dicts as S-expression
+terms, numpy/jax arrays as base64 .npy blobs (the equivalent of the
+reference's PE_DataEncode/Decode elements, reference
+examples/pipeline/elements.py:214-246).  Bulk tensor traffic should use
+the tensor transport (tpu/transfer) instead; this codec is the correctness
+fallback, not the fast path.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+
+import numpy as np
+
+__all__ = ["encode_value", "decode_value", "encode_frame_data",
+           "decode_frame_data"]
+
+_NPY_PREFIX = "npy64:"
+
+
+def encode_value(value):
+    if hasattr(value, "__array__") and not isinstance(
+            value, (str, bytes, list, tuple, dict)):
+        array = np.asarray(value)
+        buffer = io.BytesIO()
+        np.save(buffer, array, allow_pickle=False)
+        return _NPY_PREFIX + base64.b64encode(buffer.getvalue()).decode()
+    if isinstance(value, (list, tuple)):
+        return [encode_value(v) for v in value]
+    if isinstance(value, dict):
+        return {k: encode_value(v) for k, v in value.items()}
+    return value
+
+
+def decode_value(value):
+    if isinstance(value, str) and value.startswith(_NPY_PREFIX):
+        raw = base64.b64decode(value[len(_NPY_PREFIX):])
+        return np.load(io.BytesIO(raw), allow_pickle=False)
+    if isinstance(value, list):
+        return [decode_value(v) for v in value]
+    if isinstance(value, dict):
+        return {k: decode_value(v) for k, v in value.items()}
+    return value
+
+
+def encode_frame_data(frame_data: dict) -> dict:
+    return {name: encode_value(value) for name, value in frame_data.items()}
+
+
+def decode_frame_data(frame_data: dict) -> dict:
+    return {name: decode_value(value) for name, value in frame_data.items()}

@@ -1,0 +1,156 @@
+"""Pipeline definitions: typed dataclasses + JSON validation (reference:
+src/aiko_services/main/pipeline.py:222-258 dataclasses and the inline Avro
+schema at pipeline.py:1693-1822).
+
+The reference validates with Avro; this build uses a hand-rolled validator
+with precise error paths (no extra dependency) over the same information:
+name, version, runtime, graph (S-expression strings), optional default
+parameters, and one entry per element with input/output signatures and a
+deploy descriptor (local module / remote service filter).
+
+TPU extension: element definitions may carry a ``placement`` block --
+``{"devices": 4, "mesh": {"tp": 4}}`` -- consumed by the tpu substrate to
+place the element's compute onto a submesh.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+__all__ = ["PipelineDefinition", "ElementDefinition", "DefinitionError",
+           "parse_pipeline_definition", "load_pipeline_definition"]
+
+RUNTIMES = ("python", "jax")
+
+
+class DefinitionError(ValueError):
+    pass
+
+
+@dataclass
+class ElementDefinition:
+    name: str
+    input: list          # [{"name": ..., "type": ...}]
+    output: list
+    deploy_local: dict | None = None      # {"module": ..., "class_name": ...}
+    deploy_remote: dict | None = None     # ServiceFilter fields
+    parameters: dict = field(default_factory=dict)
+    placement: dict = field(default_factory=dict)
+
+    @property
+    def input_names(self) -> list[str]:
+        return [io["name"] for io in self.input]
+
+    @property
+    def output_names(self) -> list[str]:
+        return [io["name"] for io in self.output]
+
+
+@dataclass
+class PipelineDefinition:
+    name: str
+    version: int
+    runtime: str
+    graph: list[str]
+    parameters: dict = field(default_factory=dict)
+    elements: list[ElementDefinition] = field(default_factory=list)
+
+    def element(self, name: str) -> ElementDefinition:
+        for element in self.elements:
+            if element.name == name:
+                return element
+        raise DefinitionError(f"no element definition for {name!r}")
+
+    def element_names(self) -> list[str]:
+        return [e.name for e in self.elements]
+
+
+def _require(data: dict, key: str, kind, path: str):
+    if key not in data:
+        raise DefinitionError(f"{path}: missing required field {key!r}")
+    value = data[key]
+    if kind is not None and not isinstance(value, kind):
+        raise DefinitionError(
+            f"{path}.{key}: expected {getattr(kind, '__name__', kind)}, "
+            f"got {type(value).__name__}")
+    return value
+
+
+def _parse_io(entries, path: str) -> list:
+    if not isinstance(entries, list):
+        raise DefinitionError(f"{path}: expected a list")
+    result = []
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise DefinitionError(f"{path}[{i}]: expected an object")
+        name = _require(entry, "name", str, f"{path}[{i}]")
+        io_type = entry.get("type", "any")
+        result.append({"name": name, "type": io_type})
+    return result
+
+
+def parse_pipeline_definition(data: dict | str,
+                              source: str = "<definition>") \
+        -> PipelineDefinition:
+    if isinstance(data, str):
+        try:
+            data = json.loads(data)
+        except json.JSONDecodeError as error:
+            raise DefinitionError(f"{source}: invalid JSON: {error}")
+    if not isinstance(data, dict):
+        raise DefinitionError(f"{source}: definition must be an object")
+
+    name = _require(data, "name", str, source)
+    version = data.get("version", 0)
+    runtime = data.get("runtime", "jax")
+    if runtime not in RUNTIMES:
+        raise DefinitionError(
+            f"{source}.runtime: {runtime!r} not one of {RUNTIMES}")
+    graph = _require(data, "graph", list, source)
+    if not graph or not all(isinstance(g, str) for g in graph):
+        raise DefinitionError(
+            f"{source}.graph: expected non-empty list of S-expression "
+            f"strings")
+    parameters = data.get("parameters", {})
+    if not isinstance(parameters, dict):
+        raise DefinitionError(f"{source}.parameters: expected an object")
+
+    elements_data = _require(data, "elements", list, source)
+    elements = []
+    seen = set()
+    for i, entry in enumerate(elements_data):
+        path = f"{source}.elements[{i}]"
+        if not isinstance(entry, dict):
+            raise DefinitionError(f"{path}: expected an object")
+        element_name = _require(entry, "name", str, path)
+        if element_name in seen:
+            raise DefinitionError(f"{path}: duplicate element "
+                                  f"{element_name!r}")
+        seen.add(element_name)
+        deploy = entry.get("deploy", {})
+        deploy_local = deploy.get("local")
+        deploy_remote = deploy.get("remote")
+        if deploy_local is None and deploy_remote is None:
+            raise DefinitionError(
+                f"{path}.deploy: needs 'local' (module[, class_name]) or "
+                f"'remote' (service filter)")
+        if deploy_local is not None:
+            _require(deploy_local, "module", str, f"{path}.deploy.local")
+        elements.append(ElementDefinition(
+            name=element_name,
+            input=_parse_io(entry.get("input", []), f"{path}.input"),
+            output=_parse_io(entry.get("output", []), f"{path}.output"),
+            deploy_local=deploy_local,
+            deploy_remote=deploy_remote,
+            parameters=entry.get("parameters", {}),
+            placement=entry.get("placement", {})))
+
+    return PipelineDefinition(name=name, version=version, runtime=runtime,
+                              graph=list(graph), parameters=parameters,
+                              elements=elements)
+
+
+def load_pipeline_definition(pathname: str) -> PipelineDefinition:
+    with open(pathname) as fh:
+        return parse_pipeline_definition(fh.read(), source=pathname)

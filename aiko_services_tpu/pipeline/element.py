@@ -1,0 +1,128 @@
+"""PipelineElement: the unit of dataflow computation (reference:
+src/aiko_services/main/pipeline.py:376-673).
+
+An element implements ``process_frame(stream, **inputs) -> (StreamEvent,
+outputs_dict)`` plus optional ``start_stream``/``stop_stream`` lifecycle.
+Elements do not subclass Actor -- they are plain objects owned by a
+Pipeline (which IS an actor); this keeps per-element overhead at a method
+call, not a mailbox hop.
+
+Hierarchical parameter resolution (reference pipeline.py:557-595):
+stream parameters (``Element.param`` qualified, then bare) -> element
+definition parameters -> pipeline share/definition parameters.
+
+Source elements create frames either one-shot (``create_frame``) or from a
+generator pumped on a background thread with mailbox-depth backpressure
+(``create_frames``, reference pipeline.py:471-551).
+
+TPU extension: ``compile_element(stream)`` is called at start_stream time
+so jitted computations warm their caches keyed on the stream's shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from .stream import Stream, StreamEvent
+from ..utils import get_logger
+
+__all__ = ["PipelineElement", "PipelineElementLoop", "ElementContext"]
+
+_NOT_FOUND = object()
+
+
+class ElementContext:
+    """Everything an element needs from its host pipeline."""
+
+    __slots__ = ("name", "definition", "pipeline", "parameters")
+
+    def __init__(self, name: str, definition, pipeline, parameters: dict):
+        self.name = name
+        self.definition = definition
+        self.pipeline = pipeline
+        self.parameters = parameters
+
+
+class PipelineElement:
+    def __init__(self, context: ElementContext):
+        self.context = context
+        self.name = context.name
+        self.definition = context.definition
+        self.pipeline = context.pipeline
+        self.logger = get_logger(f"element.{self.name}")
+
+    # -- core API (override) ----------------------------------------------
+
+    def start_stream(self, stream: Stream, stream_id) \
+            -> tuple[StreamEvent, dict]:
+        return StreamEvent.OKAY, {}
+
+    def process_frame(self, stream: Stream, **inputs) \
+            -> tuple[StreamEvent, dict]:
+        raise NotImplementedError
+
+    def stop_stream(self, stream: Stream, stream_id):
+        return StreamEvent.OKAY, {}
+
+    def compile_element(self, stream: Stream):
+        """Optional: warm jit caches for this stream's shapes."""
+
+    # -- parameters --------------------------------------------------------
+
+    def get_parameter(self, name: str, default=None,
+                      use_pipeline: bool = True):
+        """Returns (value, found).  Resolution order: stream parameters
+        (qualified ``Element.name`` first, then bare) -> element definition
+        -> pipeline parameters."""
+        stream = self.pipeline.current_stream()
+        if stream is not None:
+            qualified = f"{self.name}.{name}"
+            if qualified in stream.parameters:
+                return stream.parameters[qualified], True
+            if name in stream.parameters:
+                return stream.parameters[name], True
+        if name in self.context.parameters:
+            return self.context.parameters[name], True
+        if use_pipeline:
+            value = self.pipeline.get_pipeline_parameter(name, _NOT_FOUND)
+            if value is not _NOT_FOUND:
+                return value, True
+        return default, False
+
+    def set_parameter(self, name: str, value):
+        self.context.parameters[name] = value
+
+    # -- frame creation (source elements) ---------------------------------
+
+    def create_frame(self, stream: Stream, frame_data: dict):
+        self.pipeline.create_frame_local(stream, frame_data)
+
+    def create_frames(self, stream: Stream,
+                      frame_generator: Callable, rate: float | None = None):
+        """Pump ``frame_generator(stream, frame_id) -> (StreamEvent,
+        frame_data)`` on a background thread with backpressure."""
+        self.pipeline.create_frame_generator(stream, self, frame_generator,
+                                             rate)
+
+    # -- misc --------------------------------------------------------------
+
+    @property
+    def input_names(self) -> list[str]:
+        return self.definition.input_names if self.definition else []
+
+    @property
+    def output_names(self) -> list[str]:
+        return self.definition.output_names if self.definition else []
+
+    def my_id(self) -> str:
+        return f"{self.pipeline.name}.{self.name}"
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class PipelineElementLoop(PipelineElement):
+    """Control-flow marker: when its process_frame returns OKAY the
+    pipeline jumps back to the ``loop_start`` element and re-runs the loop
+    body; returning LOOP_END falls through to the successors (reference
+    pipeline.py:1294-1304, elements/control/elements.py:20-57)."""

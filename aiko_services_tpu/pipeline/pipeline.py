@@ -1,0 +1,562 @@
+"""Pipeline: the dataflow engine (reference: src/aiko_services/main/
+pipeline.py -- 2036 LoC; this is the TPU-first redesign, not a port).
+
+A Pipeline is an Actor hosting a DAG of PipelineElements.  Frames enter via
+``process_frame`` (wire or local), walk the graph path in deterministic DFS
+order accumulating outputs into the frame's ``swag`` (reference
+pipeline.py:1267-1360), and responses route to a local queue or a response
+topic.  Remote stages -- elements deployed in another pipeline process --
+park the frame (``paused_pe_name``), forward the mapped inputs over the
+fabric, and resume via ``process_frame_response`` +
+``Graph.iterate_after`` (reference pipeline.py:1328-1347,1452-1455).
+
+Differences from the reference, by design:
+- single-owner frames on one event loop: no stream lock, no thread-local
+  stream context (the reference's documented race area,
+  pipeline.py:769-795,1239-1260);
+- elements are plain objects in-process (method call, not mailbox hop);
+- ``compile_element`` warm-up at stream start for jitted TPU elements;
+- frame generators remain background threads (blocking IO) but hand frames
+  over by message with mailbox-depth backpressure (reference
+  pipeline.py:495-502).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from .codec import decode_frame_data, encode_frame_data
+from .definition import (PipelineDefinition, parse_pipeline_definition,
+                         load_pipeline_definition, DefinitionError)
+from .element import ElementContext, PipelineElement, PipelineElementLoop
+from .stream import (Stream, Frame, StreamEvent, StreamState,
+                     DEFAULT_STREAM_ID)
+from ..runtime import Lease
+from ..services import Actor, ServiceFilter, get_service_proxy, do_discovery
+from ..services.service import SERVICE_PROTOCOL_PREFIX
+from ..utils import (Graph, GraphError, get_logger, generate, load_module,
+                     parse_number, process_memory_rss)
+
+__all__ = ["Pipeline", "PROTOCOL_PIPELINE", "RemoteStage"]
+
+_logger = get_logger("aiko.pipeline")
+
+PROTOCOL_PIPELINE = f"{SERVICE_PROTOCOL_PREFIX}/pipeline:0"
+_BACKPRESSURE_DEPTH = 32          # frames queued before a source waits
+_BACKPRESSURE_SLEEP = 0.005
+_GRACE_TIME_DEFAULT = 120.0
+_METRICS_MEMORY = False           # RSS deltas per element when True
+
+
+class RemoteStage(PipelineElement):
+    """Placeholder element for a stage deployed in another pipeline
+    process (reference PipelineElementDeployRemote, pipeline.py:246-258,
+    858-891).  Holds the discovered service topic; the engine does the
+    park/forward/resume dance."""
+
+    def __init__(self, context, service_filter: ServiceFilter):
+        super().__init__(context)
+        self.service_filter = service_filter
+        self.remote_topic_path: str | None = None
+        self._discovery = None
+
+    def start_discovery(self):
+        self._discovery = do_discovery(
+            self.pipeline.runtime, self.service_filter,
+            add_handler=self._on_found, remove_handler=self._on_lost)
+
+    def _on_found(self, record, proxy):
+        self.remote_topic_path = record.topic_path
+        self.logger.info("remote stage %s found: %s",
+                         self.name, record.topic_path)
+
+    def _on_lost(self, record, proxy):
+        if record.topic_path == self.remote_topic_path:
+            self.remote_topic_path = None
+            self.logger.warning("remote stage %s lost", self.name)
+
+    def process_frame(self, stream, **inputs):
+        raise RuntimeError("RemoteStage frames are forwarded, not invoked")
+
+
+class Pipeline(Actor):
+    def __init__(self, definition: PipelineDefinition | dict | str,
+                 name: str | None = None, runtime=None, tags=None,
+                 frame_codec=None):
+        if not isinstance(definition, PipelineDefinition):
+            definition = parse_pipeline_definition(definition)
+        self.definition = definition
+        super().__init__(name or definition.name, PROTOCOL_PIPELINE,
+                         tags=tags, runtime=runtime)
+        self.streams: dict[str, Stream] = {}
+        self._current_stream_ref: Stream | None = None
+        self._pipeline_parameters = dict(definition.parameters)
+        self.graph = self._build_graph()
+        self.share["element_count"] = len(self.graph)
+        self.share["streams"] = 0
+        self.share["frames_processed"] = 0
+        self._frames_processed = 0
+
+        self.add_hook("pipeline.process_frame:0")
+        self.add_hook("pipeline.process_element:0")
+
+    # -- graph construction ------------------------------------------------
+
+    def _build_graph(self) -> Graph:
+        graph = Graph.traverse(self.definition.graph)
+        graph.validate_acyclic()
+        for node in graph.nodes():
+            element_def = self.definition.element(node.name)
+            context = ElementContext(node.name, element_def, self,
+                                     dict(element_def.parameters))
+            if element_def.deploy_local is not None:
+                cls = self._load_element_class(element_def.deploy_local)
+                node.element = cls(context)
+            else:
+                service_filter = ServiceFilter(
+                    **{k: v for k, v in element_def.deploy_remote.items()
+                       if k in ("name", "protocol", "transport", "owner",
+                                "tags")})
+                stage = RemoteStage(context, service_filter)
+                stage.start_discovery()
+                node.element = stage
+        return graph
+
+    @staticmethod
+    def _load_element_class(deploy_local: dict):
+        module = load_module(deploy_local["module"])
+        class_name = deploy_local.get("class_name")
+        if class_name is None:
+            raise DefinitionError(
+                f"deploy.local needs class_name (module "
+                f"{deploy_local['module']!r})")
+        try:
+            return getattr(module, class_name)
+        except AttributeError:
+            raise DefinitionError(
+                f"{deploy_local['module']}: no class {class_name!r}")
+
+    # -- parameters --------------------------------------------------------
+
+    def get_pipeline_parameter(self, name: str, default=None):
+        if name in self.share:
+            return self.share[name]
+        return self._pipeline_parameters.get(name, default)
+
+    def set_pipeline_parameter(self, name: str, value):
+        self._pipeline_parameters[name] = value
+
+    def current_stream(self) -> Stream | None:
+        return self._current_stream_ref
+
+    # -- stream lifecycle --------------------------------------------------
+
+    def create_stream(self, stream_id=None, *parameters):
+        """Wire command: ``(create_stream id (params...) grace_time)``."""
+        params = dict(parameters[0]) if parameters and isinstance(
+            parameters[0], dict) else {}
+        grace_time = parse_number(parameters[1], _GRACE_TIME_DEFAULT) \
+            if len(parameters) > 1 else _GRACE_TIME_DEFAULT
+        self.create_stream_local(stream_id or DEFAULT_STREAM_ID,
+                                 parameters=params, grace_time=grace_time)
+
+    def create_stream_local(self, stream_id, parameters=None,
+                            graph_path=None, grace_time=_GRACE_TIME_DEFAULT,
+                            queue_response=None, topic_response=None) \
+            -> Stream | None:
+        stream_id = str(stream_id)
+        if stream_id in self.streams:
+            self.logger.warning("stream %s already exists", stream_id)
+            return self.streams[stream_id]
+        stream = Stream(stream_id=stream_id, graph_path=graph_path,
+                        parameters=dict(parameters or {}),
+                        queue_response=queue_response,
+                        topic_response=topic_response)
+        if grace_time:
+            stream.lease = Lease(
+                self.runtime.engine, float(grace_time), stream_id,
+                expired_handler=lambda lease: self.destroy_stream(
+                    lease.lease_uuid))
+        self.streams[stream_id] = stream
+        self.ec_producer.update("streams", len(self.streams))
+
+        self._current_stream_ref = stream
+        try:
+            for node in self._stream_path(stream):
+                element = node.element
+                if isinstance(element, RemoteStage):
+                    self._forward_stream_op(element, "create_stream",
+                                            stream, grace_time)
+                    continue
+                element.compile_element(stream)
+                event, diagnostic = element.start_stream(stream, stream_id) \
+                    or (StreamEvent.OKAY, {})
+                if event == StreamEvent.ERROR:
+                    self.logger.error("start_stream %s failed: %s",
+                                      node.name, diagnostic)
+                    self._destroy_stream_now(stream_id)
+                    return None
+        finally:
+            self._current_stream_ref = None
+        stream.state = StreamState.RUN
+        return stream
+
+    def _stream_path(self, stream: Stream):
+        return self.graph.get_path(stream.graph_path)
+
+    def _forward_stream_op(self, stage: RemoteStage, op: str,
+                           stream: Stream, *args):
+        if stage.remote_topic_path is None:
+            return
+        proxy = get_service_proxy(self.runtime, stage.remote_topic_path)
+        getattr(proxy, op)(stream.stream_id, *args)
+
+    def destroy_stream(self, stream_id=None, graceful=False):
+        graceful = graceful in (True, "True", "true", "1")
+        stream_id = str(stream_id or DEFAULT_STREAM_ID)
+        stream = self.streams.get(stream_id)
+        if stream is None:
+            return
+        if graceful and stream.in_flight:
+            # retry shortly; frames still pending
+            self.post_self("destroy_stream", [stream_id, True], delay=0.1)
+            return
+        self._destroy_stream_now(stream_id)
+
+    def _destroy_stream_now(self, stream_id: str):
+        stream = self.streams.pop(stream_id, None)
+        if stream is None:
+            return
+        if stream.state != StreamState.ERROR:
+            stream.state = StreamState.STOP
+        if stream.lease is not None:
+            stream.lease.terminate()
+        self._current_stream_ref = stream
+        try:
+            for node in self._stream_path(stream):
+                element = node.element
+                try:
+                    if isinstance(element, RemoteStage):
+                        self._forward_stream_op(element, "destroy_stream",
+                                                stream)
+                    else:
+                        element.stop_stream(stream, stream_id)
+                except Exception:
+                    self.logger.exception("stop_stream %s failed", node.name)
+        finally:
+            self._current_stream_ref = None
+        self.ec_producer.update("streams", len(self.streams))
+
+    # -- frame ingestion ---------------------------------------------------
+
+    def process_frame(self, stream_dict=None, frame_data=None):
+        """Wire command: ``(process_frame (stream_id: X ...) (k: v ...))``.
+        Values arrive as strings/encoded blobs; decode and run."""
+        stream_dict = dict(stream_dict or {})
+        frame_data = decode_frame_data(dict(frame_data or {}))
+        self._ingest(stream_dict, frame_data)
+
+    def process_frame_local(self, frame_data: dict,
+                            stream_id=DEFAULT_STREAM_ID,
+                            queue_response=None) -> None:
+        """In-process API: no encoding, swag values pass by reference.
+        Thread-safe (hops through the actor mailbox)."""
+        self.post_self("ingest_local",
+                       [str(stream_id), frame_data, queue_response])
+
+    def ingest_local(self, stream_id, frame_data, queue_response=None):
+        stream = self.streams.get(str(stream_id))
+        if stream is None:
+            stream = self.create_stream_local(stream_id,
+                                              queue_response=queue_response)
+            if stream is None:
+                return
+        elif queue_response is not None:
+            stream.queue_response = queue_response
+        frame = Frame(frame_id=stream.next_frame_id(),
+                      swag=dict(frame_data))
+        stream.frames[frame.frame_id] = frame
+        self._process_frame_common(stream, frame)
+
+    def _ingest(self, stream_dict: dict, frame_data: dict):
+        stream_id = str(stream_dict.get("stream_id", DEFAULT_STREAM_ID))
+        stream = self.streams.get(stream_id)
+        if stream is None:
+            stream = self.create_stream_local(stream_id)
+            if stream is None:
+                return
+        frame_id = parse_number(stream_dict.get("frame_id"), None)
+        if frame_id is None:
+            frame_id = stream.next_frame_id()
+        frame = Frame(frame_id=int(frame_id), swag=dict(frame_data))
+        frame.response_topic = stream_dict.get("response_topic")
+        stream.frames[frame.frame_id] = frame
+        self._process_frame_common(stream, frame)
+
+    # -- the hot loop ------------------------------------------------------
+
+    def _process_frame_common(self, stream: Stream, frame: Frame,
+                              nodes=None):
+        if stream.state not in (StreamState.START, StreamState.RUN):
+            stream.frames.pop(frame.frame_id, None)
+            return
+        self.run_hook("pipeline.process_frame:0",
+                      lambda: {"stream": stream.stream_id,
+                               "frame": frame.frame_id})
+        if nodes is None:
+            nodes = self._stream_path(stream)
+        frame.metrics.setdefault("time_pipeline_start", time.perf_counter())
+        self._current_stream_ref = stream
+        swag = frame.swag
+        try:
+            index = 0
+            while index < len(nodes):
+                node = nodes[index]
+                element = node.element
+                if isinstance(element, RemoteStage):
+                    if self._forward_frame(stream, frame, node):
+                        return            # frame parked at remote stage
+                    # remote unavailable: retry the whole frame shortly
+                    stream.frames.pop(frame.frame_id, None)
+                    self.post_self("retry_frame",
+                                   [stream.stream_id, frame], delay=0.25)
+                    return
+                inputs, missing = self._map_in(node, swag)
+                if missing:
+                    self._frame_error(
+                        stream, frame,
+                        f"{node.name}: missing inputs {missing}")
+                    return
+                self.run_hook("pipeline.process_element:0",
+                              lambda: {"element": node.name,
+                                       "frame": frame.frame_id})
+                start = time.perf_counter()
+                if _METRICS_MEMORY:
+                    rss_before = process_memory_rss()
+                try:
+                    result = element.process_frame(stream, **inputs)
+                except Exception as error:
+                    self.logger.exception("element %s raised", node.name)
+                    self._frame_error(stream, frame,
+                                      f"{node.name}: {error}")
+                    return
+                frame.metrics[f"{node.name}_time"] = \
+                    time.perf_counter() - start
+                if _METRICS_MEMORY:
+                    frame.metrics[f"{node.name}_memory"] = \
+                        process_memory_rss() - rss_before
+                event, outputs = result if isinstance(result, tuple) \
+                    else (result, {})
+                outputs = outputs or {}
+
+                if event == StreamEvent.OKAY and isinstance(
+                        element, PipelineElementLoop):
+                    self._map_out(node, swag, outputs)
+                    loop_start, found = element.get_parameter("loop_start")
+                    if not found or loop_start not in self.graph:
+                        self._frame_error(
+                            stream, frame,
+                            f"{node.name}: bad loop_start {loop_start!r}")
+                        return
+                    nodes = self.graph.get_path(loop_start)
+                    index = 0
+                    continue
+                if event in (StreamEvent.OKAY, StreamEvent.LOOP_END):
+                    self._map_out(node, swag, outputs)
+                    index += 1
+                    continue
+                if event == StreamEvent.DROP_FRAME:
+                    frame.metrics["dropped"] = True
+                    break
+                if event == StreamEvent.STOP:
+                    self._map_out(node, swag, outputs)
+                    stream.state = StreamState.STOP
+                    break
+                if event == StreamEvent.ERROR:
+                    diagnostic = outputs.get("diagnostic", "") \
+                        if isinstance(outputs, dict) else ""
+                    self._frame_error(stream, frame,
+                                      f"{node.name}: {diagnostic}")
+                    return
+                self._frame_error(stream, frame,
+                                  f"{node.name}: bad event {event!r}")
+                return
+            self._frame_done(stream, frame, nodes)
+        finally:
+            self._current_stream_ref = None
+
+    def retry_frame(self, stream_id, frame: Frame):
+        stream = self.streams.get(str(stream_id))
+        if stream is None:
+            return
+        stream.frames[frame.frame_id] = frame
+        self._process_frame_common(stream, frame)
+
+    # -- name mapping ------------------------------------------------------
+
+    @staticmethod
+    def _map_in(node, swag: dict) -> tuple[dict, list]:
+        element = node.element
+        inputs, missing = {}, []
+        mapping = node.properties or {}
+        for io in (element.definition.input if element.definition else []):
+            name = io["name"]
+            key = mapping.get(name, name)
+            if key in swag:
+                inputs[name] = swag[key]
+            elif io.get("type", "").endswith("?") or "default" in io:
+                inputs[name] = io.get("default")
+            else:
+                missing.append(name)
+        return inputs, missing
+
+    @staticmethod
+    def _map_out(node, swag: dict, outputs: dict):
+        for name, value in outputs.items():
+            swag[name] = value
+            swag[f"{node.name}.{name}"] = value
+
+    # -- completion / errors / responses ----------------------------------
+
+    def _frame_done(self, stream: Stream, frame: Frame, nodes):
+        frame.metrics["time_pipeline"] = (
+            time.perf_counter() - frame.metrics["time_pipeline_start"])
+        stream.frames.pop(frame.frame_id, None)
+        self._frames_processed += 1
+        self.share["frames_processed"] = self._frames_processed
+        if not frame.metrics.get("dropped"):
+            self._respond(stream, frame, okay=True)
+        if stream.state == StreamState.STOP:
+            self.post_self("destroy_stream", [stream.stream_id, True])
+
+    def _frame_error(self, stream: Stream, frame: Frame, diagnostic: str):
+        self.logger.error("stream %s frame %s: %s",
+                          stream.stream_id, frame.frame_id, diagnostic)
+        stream.frames.pop(frame.frame_id, None)
+        stream.state = StreamState.ERROR
+        self._respond(stream, frame, okay=False, diagnostic=diagnostic)
+        self.post_self("destroy_stream", [stream.stream_id])
+
+    def _respond(self, stream: Stream, frame: Frame, okay: bool,
+                 diagnostic: str = ""):
+        if frame.response_topic:
+            bare_swag = {k: v for k, v in frame.swag.items()
+                         if "." not in k}
+            payload = generate("process_frame_response", [
+                {"stream_id": stream.stream_id,
+                 "frame_id": frame.frame_id,
+                 "okay": okay, "diagnostic": diagnostic},
+                encode_frame_data(bare_swag)])
+            self.runtime.message.publish(frame.response_topic, payload)
+        if stream.queue_response is not None:
+            stream.queue_response.put(
+                (stream.stream_id, frame.frame_id,
+                 dict(frame.swag), frame.metrics, okay, diagnostic))
+
+    # -- remote stage park / forward / resume ------------------------------
+
+    def _forward_frame(self, stream: Stream, frame: Frame, node) -> bool:
+        stage: RemoteStage = node.element
+        if stage.remote_topic_path is None:
+            return False
+        frame.paused_pe_name = node.name
+        inputs, _ = self._map_in(node, frame.swag)
+        # Forward ALL mapped inputs; the remote pipeline maps what it needs.
+        payload = generate("process_frame", [
+            {"stream_id": stream.stream_id, "frame_id": frame.frame_id,
+             "response_topic": self.topic_in},
+            encode_frame_data(inputs if inputs else {
+                k: v for k, v in frame.swag.items() if "." not in k})])
+        self.runtime.message.publish(f"{stage.remote_topic_path}/in",
+                                     payload)
+        return True
+
+    def process_frame_response(self, stream_dict=None, frame_data=None):
+        """Continuation: a parked frame's remote outputs arrived
+        (reference pipeline.py:1218-1221,1452-1455)."""
+        stream_dict = dict(stream_dict or {})
+        stream_id = str(stream_dict.get("stream_id", DEFAULT_STREAM_ID))
+        stream = self.streams.get(stream_id)
+        if stream is None:
+            return
+        frame_id = int(parse_number(stream_dict.get("frame_id"), -1))
+        frame = stream.frames.get(frame_id)
+        if frame is None or frame.paused_pe_name is None:
+            return
+        okay = str(stream_dict.get("okay", "true")).lower() != "false"
+        if not okay:
+            self._frame_error(stream, frame,
+                              f"remote {frame.paused_pe_name}: "
+                              f"{stream_dict.get('diagnostic', '')}")
+            return
+        outputs = decode_frame_data(dict(frame_data or {}))
+        node = self.graph.get_node(frame.paused_pe_name)
+        self._map_out(node, frame.swag, outputs)
+        resume_after = frame.paused_pe_name
+        frame.paused_pe_name = None
+        nodes = self.graph.iterate_after(resume_after, stream.graph_path)
+        self._process_frame_common(stream, frame, nodes=nodes)
+
+    # -- frame generators (source elements) --------------------------------
+
+    def create_frame_local(self, stream: Stream, frame_data: dict):
+        self.post_self("ingest_local", [stream.stream_id, frame_data, None])
+
+    def create_frame_generator(self, stream: Stream, element,
+                               frame_generator, rate: float | None):
+        stop_event = threading.Event()
+        stream.generator_handles.append(stop_event)
+        interval = (1.0 / rate) if rate else 0.0
+        engine = self.runtime.engine
+        mailbox = self._mailbox_in
+
+        def pump():
+            next_due = time.monotonic()
+            while not stop_event.is_set() and stream.state in (
+                    StreamState.START, StreamState.RUN):
+                if engine.mailbox_size(mailbox) >= _BACKPRESSURE_DEPTH:
+                    time.sleep(_BACKPRESSURE_SLEEP)
+                    continue
+                try:
+                    event, frame_data = frame_generator(stream)
+                except Exception:
+                    self.logger.exception("frame generator %s raised",
+                                          element.name)
+                    break
+                if event == StreamEvent.OKAY:
+                    self.post_self("ingest_local",
+                                   [stream.stream_id, frame_data, None])
+                elif event == StreamEvent.NO_FRAME:
+                    time.sleep(0.02)
+                    continue
+                else:
+                    self.post_self("destroy_stream",
+                                   [stream.stream_id, True])
+                    break
+                if interval:
+                    next_due += interval
+                    delay = next_due - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+            try:
+                stream.generator_handles.remove(stop_event)
+            except ValueError:
+                pass
+
+        thread = threading.Thread(
+            target=pump, daemon=True,
+            name=f"frame-gen-{self.name}-{element.name}")
+        thread.start()
+
+    def stop(self):
+        for stream_id in list(self.streams):
+            self._destroy_stream_now(stream_id)
+        super().stop()
+
+
+def create_pipeline(definition_pathname: str, name=None, runtime=None) \
+        -> Pipeline:
+    definition = load_pipeline_definition(definition_pathname)
+    return Pipeline(definition, name=name, runtime=runtime)

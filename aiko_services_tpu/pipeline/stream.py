@@ -1,0 +1,78 @@
+"""Streams and Frames (reference: src/aiko_services/main/stream.py).
+
+A Stream is a long-lived flow of Frames through a pipeline graph path; a
+Frame is one unit of work: its ``swag`` accumulates every element's outputs
+as the frame walks the graph (reference stream.py:71-126).  ``swag`` values
+are arbitrary Python objects -- in the TPU data plane they are
+``jax.Array``s that stay resident in HBM between elements.
+
+Unlike the reference (which shares one mutable swag across threads and has
+documented frame-id races, reference pipeline.py:1239-1260), frames here
+are owned by exactly one event-loop task at a time: generators hand frames
+over by message, never by shared mutation.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+__all__ = ["StreamEvent", "StreamState", "Frame", "Stream",
+           "DEFAULT_STREAM_ID", "FIRST_FRAME_ID"]
+
+DEFAULT_STREAM_ID = "0"
+FIRST_FRAME_ID = 0
+
+
+class StreamEvent(enum.Enum):
+    """Returned by every element's process_frame (reference
+    stream.py:35-52)."""
+    OKAY = "okay"              # continue through the graph
+    DROP_FRAME = "drop_frame"  # silently stop processing this frame
+    ERROR = "error"            # abort frame, stream enters ERROR
+    NO_FRAME = "no_frame"      # source has nothing yet (generators only)
+    STOP = "stop"              # graceful stream stop after this frame
+    LOOP_END = "loop_end"      # Loop element: exit the loop body
+
+
+class StreamState(enum.Enum):
+    START = "start"
+    RUN = "run"
+    STOP = "stop"
+    ERROR = "error"
+
+
+@dataclass
+class Frame:
+    frame_id: int
+    swag: dict = field(default_factory=dict)
+    metrics: dict = field(default_factory=dict)
+    paused_pe_name: str | None = None    # set while parked at a remote stage
+    response_topic: str | None = None    # where process_frame_response goes
+    created: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class Stream:
+    stream_id: str
+    graph_path: str | None = None
+    parameters: dict = field(default_factory=dict)
+    variables: dict = field(default_factory=dict)
+    state: StreamState = StreamState.START
+    frames: dict = field(default_factory=dict)      # frame_id -> Frame
+    frame_count: int = 0                            # next frame id
+    topic_response: str | None = None
+    queue_response: Any = None                      # local queue.Queue
+    lease: Any = None
+    generator_handles: list = field(default_factory=list)
+
+    def next_frame_id(self) -> int:
+        frame_id = self.frame_count
+        self.frame_count += 1
+        return frame_id
+
+    @property
+    def in_flight(self) -> int:
+        return len(self.frames)

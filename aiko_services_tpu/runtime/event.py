@@ -16,6 +16,7 @@ threads (e.g. an MQTT network thread) use the thread-safe ``post`` /
 from __future__ import annotations
 
 import asyncio
+import collections
 import heapq
 import inspect
 import itertools
@@ -47,7 +48,8 @@ class _Mailbox:
     def __init__(self, name, handler, priority):
         self.name = name
         self.handler = handler
-        self.queue: list = []        # drained on the loop thread only
+        # drained on the loop thread only; deque for O(1) popleft
+        self.queue: collections.deque = collections.deque()
         self.priority = priority
 
 
@@ -66,6 +68,7 @@ class EventEngine:
         self._running = False
         self._pending_pre_loop: list[Callable] = []
         self._lock = threading.Lock()
+        self._current_timer: _Timer | None = None
         self._idle_waiters: list[asyncio.Future] = []
 
     # -- loop lifecycle ----------------------------------------------------
@@ -93,7 +96,8 @@ class EventEngine:
             pre, self._pending_pre_loop = self._pending_pre_loop, []
         for fn in pre:
             self._call(fn)
-        deadline = (time.monotonic() + timeout) if timeout else None
+        deadline = (time.monotonic() + timeout) \
+            if timeout is not None else None
         try:
             while not self._terminated:
                 if until is not None and until():
@@ -187,9 +191,15 @@ class EventEngine:
         if isinstance(handler_or_timer, _Timer):
             handler_or_timer.cancelled = True
             return
-        for _, _, timer in self._timers:
-            if timer.handler == handler_or_timer:
-                timer.cancelled = True
+        # A periodic timer being executed right now is off the heap; mark
+        # it too so it is not re-armed (cancel-from-own-handler case).
+        current = self._current_timer
+        if current is not None and current.handler == handler_or_timer:
+            current.cancelled = True
+        with self._lock:
+            for _, _, timer in self._timers:
+                if timer.handler == handler_or_timer:
+                    timer.cancelled = True
 
     def _push_timer(self, timer: _Timer):
         with self._lock:
@@ -211,7 +221,11 @@ class EventEngine:
                 if deadline > now:
                     return deadline
                 heapq.heappop(self._timers)
-            self._call(timer.handler)
+            self._current_timer = timer
+            try:
+                self._call(timer.handler)
+            finally:
+                self._current_timer = None
             if not timer.once and not timer.cancelled:
                 timer.deadline = now + timer.period
                 self._push_timer(timer)
@@ -261,7 +275,7 @@ class EventEngine:
                 best = mailbox
         if best is None:
             return False
-        item = best.queue.pop(0)
+        item = best.queue.popleft()
         self._call(best.handler, item)
         return True
 

@@ -187,7 +187,12 @@ class ProcessRuntime:
             return
         if command != "primary":
             return
+        previous = self.registrar
         if parameters and parameters[0] == "found":
+            new_topic = parameters[1] if len(parameters) > 1 else None
+            if previous is not None \
+                    and previous.get("topic_path") == new_topic:
+                return       # unchanged (retained redelivery): no churn
             self.registrar = {
                 "topic_path": parameters[1] if len(parameters) > 1 else None,
                 "version": parameters[2] if len(parameters) > 2 else None,
@@ -197,6 +202,8 @@ class ProcessRuntime:
                 self._register_service(service)
             self.connection.update(ConnectionState.REGISTRAR)
         elif parameters and parameters[0] == "absent":
+            if previous is None:
+                return       # already absent: no churn
             self.registrar = None
             if self.connection.state == ConnectionState.REGISTRAR:
                 self.connection.update(ConnectionState.TRANSPORT)

@@ -109,7 +109,7 @@ def do_command(runtime, interface, service_filter: ServiceFilter,
                once: bool = True) -> ServiceDiscovery:
     """Run ``command_handler(proxy)`` against each (or the first) service
     matching the filter, as they are discovered."""
-    state = {"done": False, "discovery": None}
+    state = {"done": False}
 
     def on_add(record, proxy):
         if once and state["done"]:
@@ -117,10 +117,8 @@ def do_command(runtime, interface, service_filter: ServiceFilter,
         state["done"] = True
         command_handler(proxy)
 
-    discovery = do_discovery(runtime, service_filter, on_add,
-                             interface=interface)
-    state["discovery"] = discovery
-    return discovery
+    return do_discovery(runtime, service_filter, on_add,
+                        interface=interface)
 
 
 _request_ids = itertools.count()

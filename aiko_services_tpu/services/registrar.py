@@ -80,17 +80,21 @@ class Registrar(Actor):
         self.promotion_timestamp = time.time()
         self._set_state("primary")
         message = self.runtime.message
-        message.set_last_will_and_testament(
-            self.runtime.topic_registrar_boot, "(primary absent)",
-            retain=True)
-        message.publish(
+        # Secondary will alongside the process LWT, not replacing it.
+        message.add_will("registrar_boot",
+                         self.runtime.topic_registrar_boot,
+                         "(primary absent)", retain=True)
+        self._publish_found()
+        _logger.info("registrar %s promoted to primary", self.topic_path)
+        # Register ourselves (process.on_registrar also fires for us).
+
+    def _publish_found(self):
+        self.runtime.message.publish(
             self.runtime.topic_registrar_boot,
             generate("primary", ["found", self.topic_path,
                                  REGISTRAR_BOOT_VERSION,
                                  self.promotion_timestamp]),
             retain=True)
-        _logger.info("registrar %s promoted to primary", self.topic_path)
-        # Register ourselves (process.on_registrar also fires for us).
 
     def _on_boot_topic(self, topic: str, payload):
         try:
@@ -128,19 +132,18 @@ class Registrar(Actor):
                     _logger.warning(
                         "registrar conflict: %s re-asserting over %s",
                         self.topic_path, other_topic)
-                    self.runtime.message.publish(
-                        self.runtime.topic_registrar_boot,
-                        generate("primary",
-                                 ["found", self.topic_path,
-                                  REGISTRAR_BOOT_VERSION,
-                                  self.promotion_timestamp]),
-                        retain=True)
+                    self._publish_found()
         elif parameters[0] == "absent":
             if self.state == "secondary":
                 self._enter_primary_search()
+            elif self.state == "primary":
+                # A demoted/buggy peer's will clobbered my live record:
+                # re-assert so bootstrapping processes find me.
+                self._publish_found()
 
     def _demote(self):
         self._set_state("secondary")
+        self.runtime.message.remove_will("registrar_boot")
         self.registry = ServiceRegistry()
         self.share["service_count"] = 0
 
@@ -171,9 +174,6 @@ class Registrar(Actor):
     def query(self, *parameters):
         """(query response_topic <filter...>) -- one-shot, no events."""
         self._respond_share(list(parameters))
-
-    def _topic_in_handler_share(self, parameters: list):
-        self._respond_share(parameters)
 
     def _respond_share(self, parameters: list):
         if not parameters:
@@ -232,8 +232,22 @@ class Registrar(Actor):
             return
         if command != "absent":
             return
-        # topic = {ns}/{host}/{pid}/{sid}/state
-        process_topic = topic.rsplit("/", 1)[0].rsplit("/", 1)[0]
+        # topic = {ns}/{host}/{pid}/{sid}/state.  Only the process-level
+        # service (id 0, the runtime's own LWT) means the whole process
+        # died; a non-zero id announces just that one service's departure
+        # (reference registrar.py:331-339).
+        service_topic = topic.rsplit("/", 1)[0]
+        service_id = service_topic.rsplit("/", 1)[1]
+        if service_id != "0":
+            record = self.registry.get(service_topic)
+            if record is not None:
+                self.registry.remove(service_topic)
+                self._history_note("remove", record)
+                self.publish_out("remove", [service_topic])
+                self.ec_producer.update("service_count",
+                                        len(self.registry))
+            return
+        process_topic = service_topic.rsplit("/", 1)[0]
         removed = self.registry.remove_process(process_topic)
         for record in removed:
             self._history_note("remove", record)

@@ -280,19 +280,18 @@ class ServicesCache:
             self.runtime.remove_message_handler(self._on_event,
                                                 self._registrar_out)
             self._registrar_out = None
-        if registrar is None:
-            self.state = "empty"
-            return
-        new_out = f"{registrar['topic_path']}/out"
-        if self.state != "empty":
-            # Registrar changed (failover): drop the old mirror, notifying
-            # remove handlers, then re-share against the new primary.
+        # Registrar lost OR changed: the mirror is stale either way.
+        # Purge it, notifying remove handlers, before (re)sharing.
+        if len(self.registry):
             for record in self.registry.all():
                 for add_h, remove_h, flt in list(self._handlers):
                     if remove_h and flt.matches(record):
                         remove_h(record)
             self.registry = ServiceRegistry()
-        self._registrar_out = new_out
+        if registrar is None:
+            self.state = "empty"
+            return
+        self._registrar_out = f"{registrar['topic_path']}/out"
         self.runtime.add_message_handler(self._on_event, self._registrar_out)
         self.state = "share"
         self.runtime.message.publish(

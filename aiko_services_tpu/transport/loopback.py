@@ -42,6 +42,9 @@ class LoopbackBroker:
             topic, payload, retain = client._lwt
             if topic:
                 self.publish(topic, payload, retain)
+            for topic, payload, retain in getattr(client, "_wills",
+                                                  {}).values():
+                self.publish(topic, payload, retain)
 
     def publish(self, topic: str, payload, retain: bool = False):
         if retain:

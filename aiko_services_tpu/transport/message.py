@@ -72,6 +72,20 @@ class Message:
     def set_last_will_and_testament(self, topic, payload, retain=False):
         self._lwt = (topic, payload, retain)
 
+    # Additional wills beyond the primary process LWT (e.g. the registrar
+    # election record).  Loopback honors all of them on abnormal
+    # disconnect; MQTT supports one will per connection, so there the
+    # newest added will replaces the connection will (same tradeoff the
+    # reference makes, reference mqtt.py:207-213).
+    def add_will(self, name: str, topic, payload, retain=False):
+        if not hasattr(self, "_wills"):
+            self._wills = {}
+        self._wills[name] = (topic, payload, retain)
+
+    def remove_will(self, name: str):
+        if hasattr(self, "_wills"):
+            self._wills.pop(name, None)
+
     # -- state fan-out -----------------------------------------------------
 
     def add_state_handler(self, handler: Callable):

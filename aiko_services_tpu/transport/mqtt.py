@@ -90,6 +90,12 @@ class MQTTMessage(Message):
         if self.state == MessageState.CONNECTED:
             self._client.unsubscribe(topic)
 
+    def add_will(self, name, topic, payload, retain=False):
+        super().add_will(name, topic, payload, retain)
+        # One will per MQTT connection: the newest addition becomes the
+        # connection will (reference-equivalent behavior).
+        self.set_last_will_and_testament(topic, payload, retain)
+
     def set_last_will_and_testament(self, topic, payload, retain=False):
         # paho requires will_set before connect: cycle the connection,
         # same constraint as the reference (mqtt.py:207-213).

@@ -81,6 +81,12 @@ class Graph:
             raise GraphError(f"graph head must be a symbol: {head!r}")
         head_node = self._ensure(head, node_properties)
         for successor in term[1:]:
+            if isinstance(successor, dict):
+                # Inline properties for this node, e.g. input name mappings:
+                # "(A (B (x: a)))" attaches {"x": "a"} to node B.
+                head_node.properties = {**(head_node.properties or {}),
+                                        **successor}
+                continue
             if isinstance(successor, str):
                 succ_name = successor
                 self._ensure(succ_name, node_properties)

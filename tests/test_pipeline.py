@@ -1,0 +1,232 @@
+"""Pipeline engine tests: definitions, graph name mapping (reference
+tests/unit/test_pipeline_graph.py matrix), stream events (reference
+tests/unit/test_stream_event.py), loops, remote two-pipeline chaining."""
+
+import json
+import queue
+
+import pytest
+
+from conftest import run_until
+from aiko_services_tpu.pipeline import (
+    Pipeline, parse_pipeline_definition, DefinitionError, StreamState)
+
+ELEMENTS = "tests/pipeline_elements.py"
+
+
+def element(name, cls, inputs, outputs, parameters=None):
+    return {"name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "deploy": {"local": {"module": ELEMENTS, "class_name": cls}},
+            "parameters": parameters or {}}
+
+
+def definition(graph, elements, name="p_test", parameters=None):
+    return {"version": 0, "name": name, "runtime": "jax", "graph": graph,
+            "parameters": parameters or {}, "elements": elements}
+
+
+def run_frame(runtime, pipeline, frame_data, timeout=5.0):
+    responses = queue.Queue()
+    pipeline.process_frame_local(frame_data, queue_response=responses)
+    run_until(runtime, lambda: not responses.empty(), timeout=timeout)
+    assert not responses.empty(), "no response (frame lost?)"
+    stream_id, frame_id, swag, metrics, okay, diagnostic = responses.get()
+    return swag, okay, diagnostic
+
+
+# -- definition validation --------------------------------------------------
+
+def test_definition_validation_errors():
+    with pytest.raises(DefinitionError, match="missing required"):
+        parse_pipeline_definition({"version": 0})
+    with pytest.raises(DefinitionError, match="runtime"):
+        parse_pipeline_definition(
+            {"name": "x", "runtime": "cuda", "graph": ["(a)"],
+             "elements": []})
+    with pytest.raises(DefinitionError, match="duplicate"):
+        parse_pipeline_definition(definition(
+            ["(A A)"], [element("A", "ElementA", ["a"], ["a"]),
+                        element("A", "ElementA", ["a"], ["a"])]))
+    with pytest.raises(DefinitionError, match="deploy"):
+        parse_pipeline_definition(definition(
+            ["(A)"], [{"name": "A", "input": [], "output": []}]))
+
+
+def test_unknown_graph_element_rejected():
+    with pytest.raises(DefinitionError, match="no element definition"):
+        Pipeline(definition(["(A B)"],
+                            [element("A", "ElementA", ["a"], ["a"])]))
+
+
+# -- graph name-mapping matrix (reference test_pipeline_graph.py) -----------
+
+def test_linear_positional_mapping(runtime):
+    """B consumes A's output by bare name."""
+    p = Pipeline(definition(
+        ["(A B C)"],
+        [element("A", "ElementA", ["a"], ["a"]),
+         element("B", "ElementB", ["a"], ["b"]),
+         element("C", "ElementC", ["b"], ["c"])]), runtime=runtime)
+    swag, okay, _ = run_frame(runtime, p, {"a": 1})
+    assert okay
+    assert swag["a"] == 1 and swag["b"] == 2 and swag["c"] == 4
+    assert swag["B.b"] == 2 and swag["C.c"] == 4
+
+
+def test_qualified_mapping(runtime):
+    """C's input b mapped from qualified A.a: c = a*2, ignoring B."""
+    p = Pipeline(definition(
+        ["(A B (C (b: A.a)))"],
+        [element("A", "ElementA", ["a"], ["a"]),
+         element("B", "ElementB", ["a"], ["b"]),
+         element("C", "ElementC", ["b"], ["c"])]), runtime=runtime)
+    swag, okay, _ = run_frame(runtime, p, {"a": 10})
+    assert okay
+    assert swag["c"] == 20          # from a=10, not b=11
+
+
+def test_renamed_input_mapping(runtime):
+    """Doubler input x mapped from swag value a."""
+    p = Pipeline(definition(
+        ["(A (D (x: a)))"],
+        [element("A", "ElementA", ["a"], ["a"]),
+         element("D", "Doubler", ["x"], ["x"])]), runtime=runtime)
+    swag, okay, _ = run_frame(runtime, p, {"a": 7})
+    assert okay and swag["x"] == 14
+
+
+def test_fanout_fanin_diamond(runtime):
+    """(A (B D) (C D)): DFS order A B D C; D runs once after B."""
+    p = Pipeline(definition(
+        ["(A (B D) (C (b: a) D))"],
+        [element("A", "ElementA", ["a"], ["a"]),
+         element("B", "ElementB", ["a"], ["b"]),
+         element("C", "ElementC", ["b"], ["c"]),
+         element("D", "AddOne", ["x"], ["x"],)]),
+        runtime=runtime)
+    # D needs input x; map from b via graph properties
+    p2 = Pipeline(definition(
+        ["(A (B (D (x: b))) (C (b: a)))"],
+        [element("A", "ElementA", ["a"], ["a"]),
+         element("B", "ElementB", ["a"], ["b"]),
+         element("C", "ElementC", ["b"], ["c"]),
+         element("D", "AddOne", ["x"], ["x"])]), name="p2",
+        runtime=runtime)
+    swag, okay, _ = run_frame(runtime, p2, {"a": 1})
+    assert okay
+    assert swag["b"] == 2           # B
+    assert swag["x"] == 3           # D = b+1
+    assert swag["c"] == 2           # C from mapped a=1
+
+
+def test_missing_input_is_frame_error(runtime):
+    p = Pipeline(definition(
+        ["(B)"], [element("B", "ElementB", ["a"], ["b"])]),
+        runtime=runtime)
+    swag, okay, diagnostic = run_frame(runtime, p, {"zzz": 1})
+    assert not okay and "missing inputs" in diagnostic
+
+
+# -- stream events ----------------------------------------------------------
+
+def test_error_event_destroys_stream_no_deadlock(runtime):
+    """Reference regression PR #32: ERROR must not deadlock the stream."""
+    p = Pipeline(definition(
+        ["(A F)"],
+        [element("A", "ElementA", ["a"], ["a"]),
+         element("F", "Failer", [], [])]), runtime=runtime)
+    swag, okay, diagnostic = run_frame(runtime, p, {"a": 1})
+    assert not okay and "deliberate failure" in diagnostic
+    # Stream destroyed; a new frame starts a fresh stream and also errors.
+    swag, okay, _ = run_frame(runtime, p, {"a": 2})
+    assert not okay
+
+
+def test_element_exception_is_frame_error(runtime):
+    p = Pipeline(definition(
+        ["(R)"], [element("R", "Raiser", [], [])]), runtime=runtime)
+    swag, okay, diagnostic = run_frame(runtime, p, {})
+    assert not okay and "exploded" in diagnostic
+
+
+def test_stop_event_ends_stream(runtime):
+    p = Pipeline(definition(
+        ["(A S)"],
+        [element("A", "ElementA", ["a"], ["a"]),
+         element("S", "Stopper", [], [])]), runtime=runtime)
+    swag, okay, _ = run_frame(runtime, p, {"a": 1})
+    assert okay
+    run_until(runtime, lambda: not p.streams, timeout=5.0)
+    assert not p.streams
+
+
+# -- loops ------------------------------------------------------------------
+
+def test_loop_element(runtime):
+    p = Pipeline(definition(
+        ["(CNT LOOP)"],
+        [element("CNT", "Counter", ["n"], ["n"]),
+         {"name": "LOOP", "input": [], "output": [],
+          "deploy": {"local": {
+              "module": "aiko_services_tpu.elements.control",
+              "class_name": "Loop"}},
+          "parameters": {"condition": "n < 5", "loop_start": "CNT"}}]),
+        runtime=runtime)
+    swag, okay, _ = run_frame(runtime, p, {"n": 0})
+    assert okay
+    assert swag["n"] == 5
+
+
+# -- remote two-pipeline chaining (reference multitude, in one process) -----
+
+def test_remote_stage_chaining(runtime):
+    from aiko_services_tpu.services import Registrar
+    registrar = Registrar(runtime=runtime, primary_search_timeout=0.05)
+
+    child = Pipeline(definition(
+        ["(D2)"], [element("D2", "Doubler", ["x"], ["x"])],
+        name="p_child"), runtime=runtime)
+
+    parent_def = definition(
+        ["(A (REMOTE (x: a)) (INC (x: REMOTE.x)))"],
+        [element("A", "ElementA", ["a"], ["a"]),
+         {"name": "REMOTE",
+          "input": [{"name": "x"}], "output": [{"name": "x"}],
+          "deploy": {"remote": {"name": "p_child"}}},
+         element("INC", "AddOne", ["x"], ["x"])],
+        name="p_parent")
+    parent = Pipeline(parent_def, runtime=runtime)
+
+    remote_stage = parent.graph.get_node("REMOTE").element
+    run_until(runtime,
+              lambda: remote_stage.remote_topic_path is not None,
+              timeout=5.0)
+    assert remote_stage.remote_topic_path == child.topic_path
+
+    swag, okay, diagnostic = run_frame(runtime, parent, {"a": 3},
+                                       timeout=10.0)
+    assert okay, diagnostic
+    assert int(swag["REMOTE.x"]) == 6   # doubled remotely
+    assert int(swag["x"]) == 7          # then incremented locally
+
+
+def test_wire_process_frame(runtime):
+    """Frames can be injected over the fabric as S-expressions."""
+    p = Pipeline(definition(
+        ["(A B)"],
+        [element("A", "ElementA", ["a"], ["a"]),
+         element("B", "ElementB", ["a"], ["b"])],
+        name="p_wire"), runtime=runtime)
+    got = []
+    response_topic = f"{runtime.topic_path_process}/resp"
+    runtime.add_message_handler(lambda t, payload: got.append(payload),
+                                response_topic)
+    runtime.message.publish(
+        f"{p.topic_path}/in",
+        f"(process_frame (stream_id: 7 response_topic: {response_topic})"
+        f" (a: 5))")
+    run_until(runtime, lambda: bool(got), timeout=5.0)
+    assert got and "process_frame_response" in got[0]
+    assert "(b 6)" in got[0] or "b: 6" in got[0]
