@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from ..pipeline import PipelineElement, StreamEvent
 
-__all__ = ["Mock", "NoOp", "Identity", "Terminate"]
+__all__ = ["Mock", "NoOp", "Identity", "Increment", "Terminate"]
 
 
 class Mock(PipelineElement):
@@ -22,6 +22,13 @@ class NoOp(PipelineElement):
 
 class Identity(Mock):
     pass
+
+
+class Increment(PipelineElement):
+    """x -> x + 1 (the multitude benchmark's per-stage work)."""
+
+    def process_frame(self, stream, x):
+        return StreamEvent.OKAY, {"x": int(x) + 1}
 
 
 class Terminate(PipelineElement):

@@ -1,0 +1,3 @@
+from . import llama
+from .batching import ContinuousBatcher, Request
+from .tokenizer import ByteTokenizer, load_tokenizer

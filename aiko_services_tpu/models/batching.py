@@ -1,0 +1,210 @@
+"""Continuous batching for the LLM serving element (BASELINE config 3).
+
+The reference's chat element forwards to an external Ollama server
+(reference examples/llm/elements.py:92-212); here serving is native: a
+slot-based continuous batcher owns a batched KV cache in HBM and a decode
+loop on-device.
+
+Design (the "hard part" flagged in SURVEY.md section 7): many actor
+requests merge into device batches and de-multiplex back to per-request
+token streams.
+
+- ``max_slots`` sequences decode together as one [B] ``decode_step``;
+- new requests are prefix-filled with a batch-1 ``prefill`` into a scratch
+  cache, then scattered into their slot of the batched cache (jitted,
+  donated -- no host round-trip);
+- finished sequences (EOS or token budget) free their slot immediately;
+  admission happens between decode steps, so a long generation never
+  blocks a short one (continuous, not static, batching);
+- the engine is synchronous and thread-agnostic: ``step()`` advances one
+  decode tick and returns emitted (request_id, token) pairs.  The serving
+  element runs it on a worker thread and pushes tokens to actor queues.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import llama
+
+__all__ = ["Request", "ContinuousBatcher"]
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_tokens: list[int]
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+    eos_tokens: tuple = ()
+    emit: Callable | None = None     # fn(request_id, token_id, finished)
+    # runtime state
+    slot: int = -1
+    generated: int = 0
+    done: bool = False
+
+
+@partial(jax.jit, donate_argnames=("big", ))
+def _scatter_cache(big: dict, small: dict, slot: jax.Array) -> dict:
+    """Copy a batch-1 prefill cache into slot ``slot`` of the batched
+    cache.  Copies the whole max_seq extent (prefill wrote only the
+    prompt's positions; the rest is zeros which decode masks out anyway
+    -- a static-shape copy XLA handles in one fused kernel)."""
+    k = jax.lax.dynamic_update_slice_in_dim(
+        big["k"], small["k"], slot, axis=1)
+    v = jax.lax.dynamic_update_slice_in_dim(
+        big["v"], small["v"], slot, axis=1)
+    return {"k": k, "v": v}
+
+
+@jax.jit
+def _select_tokens(key: jax.Array, logits: jax.Array,
+                   temperatures: jax.Array) -> jax.Array:
+    """Per-slot sampling in one draw: rows with temperature 0 take the
+    argmax, rows with temperature > 0 take a categorical sample at their
+    OWN temperature (scale each row's logits before one batched draw)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    safe = jnp.maximum(temperatures, 0.05)[:, None]
+    sampled = jax.random.categorical(
+        key, logits.astype(jnp.float32) / safe, axis=-1)
+    return jnp.where(temperatures > 0, sampled, greedy)
+
+
+class ContinuousBatcher:
+    def __init__(self, params, config: llama.LlamaConfig,
+                 max_slots: int = 8, max_seq: int | None = None,
+                 prefill_chunk: int = 512, rng_seed: int = 0):
+        self.params = params
+        self.config = config
+        self.max_slots = max_slots
+        self.max_seq = max_seq or config.max_seq
+        self.prefill_chunk = prefill_chunk
+        self.cache = llama.init_cache(config, max_slots, self.max_seq)
+        self.lengths = np.zeros(max_slots, dtype=np.int32)
+        self.current = np.zeros(max_slots, dtype=np.int32)
+        self.temperatures = np.zeros(max_slots, dtype=np.float32)
+        self.slots: list[Request | None] = [None] * max_slots
+        self.pending: list[Request] = []
+        self._key = jax.random.PRNGKey(rng_seed)
+        # perf counters
+        self.tokens_emitted = 0
+        self.steps = 0
+        self.prefill_tokens = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, request: Request):
+        if len(request.prompt_tokens) >= self.max_seq:
+            request.prompt_tokens = \
+                request.prompt_tokens[-(self.max_seq // 2):]
+        self.pending.append(request)
+
+    def _free_slots(self) -> list[int]:
+        return [i for i, r in enumerate(self.slots) if r is None]
+
+    def _admit(self):
+        free = self._free_slots()
+        while free and self.pending:
+            slot = free.pop(0)
+            request = self.pending.pop(0)
+            self._prefill_into_slot(slot, request)
+
+    def _prefill_into_slot(self, slot: int, request: Request):
+        # An empty prompt still needs one position of context to sample
+        # from; condition it on a single pad token rather than indexing
+        # into uninitialised padding.
+        if not request.prompt_tokens:
+            request.prompt_tokens = [0]
+        prompt = np.asarray(request.prompt_tokens, dtype=np.int32)
+        length = len(prompt)
+        # pad to the chunk grid to bound recompilation
+        padded = int(np.ceil(length / self.prefill_chunk)
+                     * self.prefill_chunk)
+        padded = min(padded, self.max_seq)
+        tokens = np.zeros((1, padded), dtype=np.int32)
+        tokens[0, :length] = prompt
+        scratch = llama.init_cache(self.config, 1, self.max_seq)
+        logits, scratch = llama.prefill(
+            self.params, self.config, jnp.asarray(tokens), scratch,
+            jnp.zeros((1,), dtype=jnp.int32))
+        self.cache = _scatter_cache(self.cache, scratch, jnp.int32(slot))
+        first = self._sample(logits[:, length - 1, :],
+                             request.temperature)
+        first_token = int(jax.device_get(first)[0])
+        self.prefill_tokens += length
+        request.slot = slot
+        self.slots[slot] = request
+        self.lengths[slot] = length
+        self.current[slot] = first_token
+        self.temperatures[slot] = request.temperature
+        self._emit(request, first_token)
+
+    # -- decode ------------------------------------------------------------
+
+    def _sample(self, logits, temperature: float):
+        if temperature and temperature > 0:
+            self._key, sub = jax.random.split(self._key)
+            return llama.temperature_sample(sub, logits, temperature)
+        return llama.greedy_sample(logits)
+
+    def step(self) -> int:
+        """Admit pending requests, run one decode tick across all active
+        slots, emit tokens.  Returns number of active slots."""
+        self._admit()
+        active = [i for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return 0
+        tokens = jnp.asarray(self.current)
+        lengths = jnp.asarray(self.lengths)
+        logits, self.cache = llama.decode_step(
+            self.params, self.config, tokens, self.cache, lengths)
+        self._key, sub = jax.random.split(self._key)
+        next_tokens = np.asarray(jax.device_get(_select_tokens(
+            sub, logits, jnp.asarray(self.temperatures))), dtype=np.int32)
+        self.steps += 1
+        for i in active:
+            request = self.slots[i]
+            self.lengths[i] += 1
+            token = int(next_tokens[i])
+            self.current[i] = token
+            self._emit(request, token)
+        return len(active)
+
+    def _emit(self, request: Request, token: int):
+        request.generated += 1
+        self.tokens_emitted += 1
+        finished = (token in request.eos_tokens
+                    or request.generated >= request.max_new_tokens
+                    or self.lengths[request.slot] >= self.max_seq - 1)
+        if request.emit is not None:
+            request.emit(request.request_id, token, finished)
+        if finished:
+            request.done = True
+            self.slots[request.slot] = None
+            self.lengths[request.slot] = 0
+            self.current[request.slot] = 0
+            self.temperatures[request.slot] = 0.0
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for r in self.slots if r is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.pending)
+
+    def run_until_drained(self, max_steps: int = 100_000) -> int:
+        steps = 0
+        while (self.pending or self.active_count) and steps < max_steps:
+            self.step()
+            steps += 1
+        return steps

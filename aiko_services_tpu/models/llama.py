@@ -1,0 +1,260 @@
+"""Llama-3-family transformer, TPU-first (BASELINE config 3: the chat
+element's model; reference equivalent: examples/llm/elements.py delegates
+to an external Ollama server -- here the model IS the framework's, weights
+resident in HBM).
+
+Functional design: parameters are a pytree with layers stacked on a
+leading axis and the layer loop is a ``lax.scan`` -- one trace, one
+compile, regardless of depth.  ``partition_specs`` gives the
+Megatron-style TP (+fsdp) layout; activations carry explicit sharding
+constraints so XLA places collectives on the mesh axes
+(dp=batch, sp=sequence, tp=heads/hidden).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.layers import (rms_norm, rope_frequencies, apply_rope, swiglu,
+                          repeat_kv, attention_prefill, attention_decode)
+from ..parallel.mesh import P
+
+__all__ = ["LlamaConfig", "init_params", "partition_specs",
+           "cache_specs", "init_cache", "prefill", "decode_step",
+           "greedy_sample"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14_336
+    rope_theta: float = 500_000.0
+    max_seq: int = 8192
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def gqa_groups(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def llama3_1b(cls) -> "LlamaConfig":
+        return cls(dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+                   hidden_dim=8192)
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 512, max_seq: int = 256) \
+            -> "LlamaConfig":
+        """Test-size config: runs on CPU mesh in milliseconds."""
+        return cls(vocab_size=vocab_size, dim=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, hidden_dim=128, max_seq=max_seq,
+                   rope_theta=10_000.0)
+
+
+def _dtype(config: LlamaConfig):
+    return jnp.dtype(config.dtype)
+
+
+def init_params(key: jax.Array, config: LlamaConfig) -> dict:
+    c = config
+    dtype = _dtype(c)
+    keys = jax.random.split(key, 8)
+    hd = c.head_dim
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "embed": dense(keys[0], (c.vocab_size, c.dim), c.dim),
+        "layers": {
+            "wq": dense(keys[1], (c.n_layers, c.dim, c.n_heads * hd),
+                        c.dim),
+            "wk": dense(keys[2], (c.n_layers, c.dim, c.n_kv_heads * hd),
+                        c.dim),
+            "wv": dense(keys[3], (c.n_layers, c.dim, c.n_kv_heads * hd),
+                        c.dim),
+            "wo": dense(keys[4], (c.n_layers, c.n_heads * hd, c.dim),
+                        c.n_heads * hd),
+            "w_gate": dense(keys[5], (c.n_layers, c.dim, c.hidden_dim),
+                            c.dim),
+            "w_up": dense(keys[6], (c.n_layers, c.dim, c.hidden_dim),
+                          c.dim),
+            "w_down": dense(keys[7], (c.n_layers, c.hidden_dim, c.dim),
+                            c.hidden_dim),
+            "attn_norm": jnp.ones((c.n_layers, c.dim), dtype=dtype),
+            "mlp_norm": jnp.ones((c.n_layers, c.dim), dtype=dtype),
+        },
+        "final_norm": jnp.ones((c.dim,), dtype=dtype),
+        "unembed": dense(jax.random.fold_in(keys[0], 1),
+                         (c.dim, c.vocab_size), c.dim),
+    }
+
+
+def partition_specs(config: LlamaConfig) -> dict:
+    """Megatron TP + fsdp layout, layer axis unsharded (it is scanned)."""
+    return {
+        "embed": P("fsdp", None),
+        "layers": {
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+            "attn_norm": P(None, None),
+            "mlp_norm": P(None, None),
+        },
+        "final_norm": P(None),
+        "unembed": P("fsdp", "tp"),
+    }
+
+
+def cache_specs() -> dict:
+    """KV cache: batch over dp, kv heads over tp."""
+    return {"k": P(None, "dp", None, "tp", None),
+            "v": P(None, "dp", None, "tp", None)}
+
+
+def init_cache(config: LlamaConfig, batch: int,
+               max_seq: int | None = None) -> dict:
+    c = config
+    t = max_seq or c.max_seq
+    shape = (c.n_layers, batch, t, c.n_kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, dtype=_dtype(c)),
+            "v": jnp.zeros(shape, dtype=_dtype(c))}
+
+
+def _block(config: LlamaConfig, rope_table, hidden, layer, kv_write):
+    """One transformer block.  ``kv_write(k_new, v_new, k_layer, v_layer)
+    -> (k_layer, v_layer, k_all, v_all, lengths_mask)`` abstracts
+    prefill-vs-decode cache handling."""
+    c = config
+    b, s, _ = hidden.shape
+    hd = c.head_dim
+
+    x = rms_norm(hidden, layer["attn_norm"], c.norm_eps)
+    q = (x @ layer["wq"]).reshape(b, s, c.n_heads, hd)
+    k = (x @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = (x @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    attn_out = kv_write(q, k, v, layer)
+    hidden = hidden + attn_out.reshape(b, s, c.n_heads * hd) @ layer["wo"]
+
+    x = rms_norm(hidden, layer["mlp_norm"], c.norm_eps)
+    hidden = hidden + swiglu(x, layer["w_gate"], layer["w_up"],
+                             layer["w_down"])
+    return hidden
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
+            cache: dict, start_positions: jax.Array) \
+        -> tuple[jax.Array, dict]:
+    """Process a prompt chunk, writing the cache.
+
+    tokens: [B, S] (right-padded chunks allowed -- positions beyond a
+    sequence's true content are simply overwritten by later chunks);
+    start_positions: [B] cache offset each row's chunk begins at.
+    Returns (logits [B, S, vocab], cache).
+    """
+    c = config
+    b, s = tokens.shape
+    rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    positions = start_positions[:, None] + jnp.arange(s)[None, :]
+
+    # Activation sharding follows from the param/cache input shardings via
+    # SPMD propagation; serving/training wrappers pin in_shardings
+    # explicitly (see models/train.py, tpu elements).
+    hidden = params["embed"][tokens]                  # [B, S, D]
+
+    def layer_step(hidden, xs):
+        layer, k_layer, v_layer = xs
+
+        def kv_write(q, k, v, layer_p):
+            q = apply_rope(q, rope_table, positions)
+            k = apply_rope(k, rope_table, positions)
+            # scatter chunk into the cache at [b, start+i]
+            batch_index = jnp.arange(b)[:, None]
+            k_layer2 = k_layer.at[batch_index, positions].set(k)
+            v_layer2 = v_layer.at[batch_index, positions].set(v)
+            kv_write.updated = (k_layer2, v_layer2)
+            k_all = repeat_kv(k_layer2, c.gqa_groups)
+            v_all = repeat_kv(v_layer2, c.gqa_groups)
+            return attention_prefill(q, k_all, v_all, positions)
+
+        hidden2 = _block(c, rope_table, hidden, layer, kv_write)
+        return hidden2, kv_write.updated
+
+    hidden, (k_new, v_new) = jax.lax.scan(
+        layer_step, hidden,
+        (params["layers"], cache["k"], cache["v"]))
+    hidden = rms_norm(hidden, params["final_norm"], c.norm_eps)
+    logits = hidden @ params["unembed"]
+    return logits, {"k": k_new, "v": v_new}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
+                cache: dict, lengths: jax.Array) \
+        -> tuple[jax.Array, dict]:
+    """One token per active sequence.
+
+    tokens: [B] current tokens; lengths: [B] positions to write (= current
+    sequence length).  Returns (logits [B, vocab], cache).
+    """
+    c = config
+    b = tokens.shape[0]
+    rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    positions = lengths[:, None]                       # [B, 1]
+
+    hidden = params["embed"][tokens][:, None, :]       # [B, 1, D]
+
+    def layer_step(hidden, xs):
+        layer, k_layer, v_layer = xs
+
+        def kv_write(q, k, v, layer_p):
+            q = apply_rope(q, rope_table, positions)
+            k = apply_rope(k, rope_table, positions)
+            batch_index = jnp.arange(b)
+            k_layer2 = k_layer.at[batch_index, lengths].set(k[:, 0])
+            v_layer2 = v_layer.at[batch_index, lengths].set(v[:, 0])
+            kv_write.updated = (k_layer2, v_layer2)
+            k_all = repeat_kv(k_layer2, c.gqa_groups)
+            v_all = repeat_kv(v_layer2, c.gqa_groups)
+            return attention_decode(q, k_all, v_all, lengths + 1)
+
+        hidden2 = _block(c, rope_table, hidden, layer, kv_write)
+        return hidden2, kv_write.updated
+
+    hidden, (k_new, v_new) = jax.lax.scan(
+        layer_step, hidden,
+        (params["layers"], cache["k"], cache["v"]))
+    hidden = rms_norm(hidden, params["final_norm"], c.norm_eps)
+    logits = hidden[:, 0, :] @ params["unembed"]
+    return logits, {"k": k_new, "v": v_new}
+
+
+def greedy_sample(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits, axis=-1)
+
+
+def temperature_sample(key: jax.Array, logits: jax.Array,
+                       temperature: float = 0.7) -> jax.Array:
+    return jax.random.categorical(key, logits / temperature, axis=-1)

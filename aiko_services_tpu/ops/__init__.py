@@ -1,0 +1,2 @@
+from .layers import (rms_norm, rope_frequencies, apply_rope, swiglu,
+                     repeat_kv, attention_prefill, attention_decode)

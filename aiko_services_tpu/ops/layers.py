@@ -1,0 +1,109 @@
+"""Transformer building blocks: RMSNorm, RoPE, attention, SwiGLU.
+
+Functional JAX over explicit parameter pytrees -- no module framework in
+the hot path, so everything traces clean under jit/shard_map and the same
+code serves training and serving.  Compute dtype is bfloat16 (MXU-native);
+normalization statistics and softmax run in float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["rms_norm", "rope_frequencies", "apply_rope", "swiglu",
+           "repeat_kv", "attention_prefill", "attention_decode"]
+
+
+def rms_norm(x: jax.Array, weight: jax.Array,
+             epsilon: float = 1e-5) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
+                          + epsilon)
+    return (x32 * scale).astype(dtype) * weight
+
+
+def rope_frequencies(head_dim: int, max_positions: int,
+                     theta: float = 500_000.0) -> jax.Array:
+    """[max_positions, head_dim//2] complex-as-cos/sin table (float32)."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2,
+                                          dtype=np.float32) / head_dim))
+    positions = np.arange(max_positions, dtype=np.float32)
+    angles = np.outer(positions, inv_freq)                 # [S, hd/2]
+    return jnp.stack([np.cos(angles), np.sin(angles)])      # [2, S, hd/2]
+
+
+def apply_rope(x: jax.Array, rope_table: jax.Array,
+               positions: jax.Array) -> jax.Array:
+    """x: [B, S, H, hd]; positions: [B, S] absolute positions."""
+    cos = rope_table[0][positions]                 # [B, S, hd/2]
+    sin = rope_table[1][positions]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin,
+                               x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    gate = jax.nn.silu(x @ w_gate)
+    return (gate * (x @ w_up)) @ w_down
+
+
+def repeat_kv(kv: jax.Array, repeats: int) -> jax.Array:
+    """[B, S, K, hd] -> [B, S, K*repeats, hd] for grouped-query attention."""
+    if repeats == 1:
+        return kv
+    b, s, k, d = kv.shape
+    return jnp.broadcast_to(kv[:, :, :, None, :],
+                            (b, s, k, repeats, d)).reshape(b, s,
+                                                           k * repeats, d)
+
+
+def attention_prefill(q: jax.Array, k: jax.Array, v: jax.Array,
+                      q_positions: jax.Array,
+                      kv_length_mask: jax.Array | None = None) -> jax.Array:
+    """Causal attention for a prompt chunk.
+
+    q: [B, S, H, hd]; k/v: [B, T, H, hd] (already GQA-expanded);
+    q_positions: [B, S] absolute positions of the queries (so chunked
+    prefill against a longer cache works); kv_length_mask: [B, T] bool of
+    valid cache slots.  float32 softmax.
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bshd,bthd->bhst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    t = k.shape[1]
+    kv_positions = jnp.arange(t)[None, None, None, :]       # [1,1,1,T]
+    causal = kv_positions <= q_positions[:, None, :, None]   # [B,1,S,T]
+    if kv_length_mask is not None:
+        causal = jnp.logical_and(causal,
+                                 kv_length_mask[:, None, None, :])
+    logits = jnp.where(causal, logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd",
+                      weights.astype(v.dtype), v)
+
+
+def attention_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     lengths: jax.Array) -> jax.Array:
+    """Single-token decode against the cache.
+
+    q: [B, 1, H, hd]; k_cache/v_cache: [B, T, H, hd] (GQA-expanded);
+    lengths: [B] number of valid positions (including the token just
+    written).  Returns [B, 1, H, hd].
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bshd,bthd->bhst", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    t = k_cache.shape[1]
+    valid = jnp.arange(t)[None, None, None, :] < \
+        lengths[:, None, None, None]
+    logits = jnp.where(valid, logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd",
+                      weights.astype(v_cache.dtype), v_cache)

@@ -1,0 +1,2 @@
+from .mesh import (MeshPlan, make_mesh, submesh, device_inventory,
+                   inventory_tags, virtual_cpu_devices, P, NamedSharding)

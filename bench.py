@@ -1,0 +1,127 @@
+"""Benchmark: chained-pipeline frame throughput vs the reference's
+multitude ceiling.
+
+The reference's only in-tree end-to-end number is the "multitude" test:
+3 chained pipeline processes over mosquitto sustain ~50 frames/sec before
+falling behind (reference examples/pipeline/multitude/run_small.sh:10,21,
+BASELINE.md).  This benchmark runs the equivalent topology on this
+framework -- three Pipelines chained via discovered remote stages
+(park / forward / resume protocol), frames pumped through pipeline A and
+responses collected after C -- and reports sustained frames/sec.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "frames/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import sys
+import time
+
+os.environ.setdefault("AIKO_LOG_LEVEL", "ERROR")
+
+BASELINE_FPS = 50.0            # reference multitude run_small.sh ceiling
+FRAMES = 2000
+WARMUP = 50
+
+
+def element(name, cls, inputs, outputs, parameters=None):
+    return {"name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "deploy": {"local": {
+                "module": "aiko_services_tpu.elements.common",
+                "class_name": cls}},
+            "parameters": parameters or {}}
+
+
+def remote(name, target, inputs, outputs):
+    return {"name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "deploy": {"remote": {"name": target}}}
+
+
+def main() -> int:
+    logging.disable(logging.WARNING)
+    from aiko_services_tpu.runtime import init_process
+    from aiko_services_tpu.services import Registrar
+    from aiko_services_tpu.pipeline import Pipeline
+
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+
+    def definition(graph, elements, name):
+        return {"version": 0, "name": name, "runtime": "jax",
+                "graph": graph, "parameters": {}, "elements": elements}
+
+    # C and B are standalone pipelines; A chains A -> B -> C remotely,
+    # mirroring multitude's pipeline_small_{a,b,c}.json chain.
+    Pipeline(definition(["(C1)"],
+                        [element("C1", "Increment", ["x"], ["x"])],
+                        "bench_c"), runtime=runtime)
+    Pipeline(definition(
+        ["(B1 (RC (x: x)))"],
+        [element("B1", "Increment", ["x"], ["x"]),
+         remote("RC", "bench_c", ["x"], ["x"])],
+        "bench_b"), runtime=runtime)
+    head = Pipeline(definition(
+        ["(A1 (RB (x: x)))"],
+        [element("A1", "Increment", ["x"], ["x"]),
+         remote("RB", "bench_b", ["x"], ["x"])],
+        "bench_a"), runtime=runtime)
+
+    stages = [head.graph.get_node("RB").element]
+    runtime.run(until=lambda: all(s.remote_topic_path for s in stages),
+                timeout=10.0)
+
+    responses: "queue.Queue" = queue.Queue()
+    done = {"count": 0, "okay": 0}
+
+    def pump(n):
+        for i in range(n):
+            head.process_frame_local({"x": i}, stream_id="bench",
+                                     queue_response=responses)
+
+    def drain(target):
+        while not responses.empty():
+            *_, okay, _diag = responses.get()
+            done["count"] += 1
+            done["okay"] += bool(okay)
+        return done["count"] >= target
+
+    pump(WARMUP)
+    runtime.run(until=lambda: drain(WARMUP), timeout=30.0)
+    if done["count"] < WARMUP:
+        print(json.dumps({"metric": "chained_pipeline_throughput",
+                          "value": 0.0, "unit": "frames/sec",
+                          "vs_baseline": 0.0, "error": "warmup stalled"}))
+        return 1
+
+    warmup_okay = done["okay"]
+    start = time.perf_counter()
+    pump(FRAMES)
+    runtime.run(until=lambda: drain(WARMUP + FRAMES), timeout=120.0)
+    elapsed = time.perf_counter() - start
+
+    completed = done["count"] - WARMUP
+    fps = completed / elapsed if elapsed > 0 else 0.0
+    print(json.dumps({
+        "metric": "chained_pipeline_throughput_3stage",
+        "value": round(fps, 1),
+        "unit": "frames/sec",
+        "vs_baseline": round(fps / BASELINE_FPS, 2),
+        "frames": completed,
+        "okay": done["okay"] - warmup_okay,
+        "elapsed_s": round(elapsed, 3),
+    }))
+    return 0 if completed == FRAMES else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

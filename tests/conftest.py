@@ -13,6 +13,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# A site hook (e.g. a TPU-tunnel PJRT plugin) may have imported jax at
+# interpreter start and overridden jax_platforms programmatically, which
+# wins over the env var; force it back before any backend initializes so
+# tests never touch (or hang on) remote hardware.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest
 
 
