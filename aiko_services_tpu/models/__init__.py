@@ -1,3 +1,4 @@
 from . import llama
+from . import long_context
 from .batching import ContinuousBatcher, Request
 from .tokenizer import ByteTokenizer, load_tokenizer

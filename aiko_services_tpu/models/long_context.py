@@ -1,0 +1,96 @@
+"""Long-context Llama forward: sequence sharded over the ``sp`` mesh axis.
+
+The reference has no within-model sequence scaling (SURVEY.md section 5.7)
+-- this is the TPU-native addition (BASELINE config 5 territory).  The
+model body is the same functional Llama as ``models/llama.py``; only the
+attention op changes: instead of dense attention over a gathered
+sequence, each device keeps its S/n chunk and attention runs as a ring
+(``ppermute`` K/V rotation) or Ulysses (head-scatter all-to-all) over
+``sp``, composed with dp batch sharding and Megatron tp via the
+surrounding ``jit``'s sharding propagation.
+
+Exposed as the ``attention=ring|ulysses`` / ``context_shards`` element
+parameters of the LLM pipeline elements (SURVEY.md section 5.7 wish).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import llama
+from ..ops.layers import (apply_rope, repeat_kv, rms_norm,
+                          rope_frequencies, swiglu)
+from ..parallel.mesh import MeshPlan, P
+from ..parallel.ring import ring_attention, ulysses_attention
+
+__all__ = ["make_long_context_forward", "make_long_context_loss"]
+
+_ATTENTION = {"ring": ring_attention, "ulysses": ulysses_attention}
+
+
+def make_long_context_forward(config: llama.LlamaConfig, plan: MeshPlan,
+                              attention: str = "ring", axis: str = "sp"):
+    """Build a jitted ``forward(params, tokens) -> logits`` with tokens
+    [B, S] sharded (batch over dp/fsdp, sequence over ``axis``)."""
+    if axis not in plan.mesh.axis_names:
+        raise ValueError(f"mesh {dict(plan.mesh.shape)} has no '{axis}' "
+                         f"axis for context parallelism")
+    attn_fn = _ATTENTION[attention]
+    c = config
+    mesh = plan.mesh
+    batch_axis = tuple(a for a in ("dp", "fsdp")
+                       if a in mesh.axis_names) or None
+    head_axis = "tp" if "tp" in mesh.axis_names else None
+
+    def forward(params, tokens):
+        b, s = tokens.shape
+        hd = c.head_dim
+        rope_table = rope_frequencies(hd, c.max_seq, c.rope_theta)
+        positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        hidden = params["embed"][tokens]
+
+        def layer_step(hidden, layer):
+            x = rms_norm(hidden, layer["attn_norm"], c.norm_eps)
+            q = (x @ layer["wq"]).reshape(b, s, c.n_heads, hd)
+            k = (x @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
+            v = (x @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
+            q = apply_rope(q, rope_table, positions)
+            k = apply_rope(k, rope_table, positions)
+            k = repeat_kv(k, c.gqa_groups)
+            v = repeat_kv(v, c.gqa_groups)
+            attn = attn_fn(q, k, v, positions, mesh, axis=axis,
+                           batch_axis=batch_axis, head_axis=head_axis)
+            hidden2 = hidden + attn.reshape(b, s, c.n_heads * hd) \
+                @ layer["wo"]
+            x2 = rms_norm(hidden2, layer["mlp_norm"], c.norm_eps)
+            hidden2 = hidden2 + swiglu(x2, layer["w_gate"],
+                                       layer["w_up"], layer["w_down"])
+            return hidden2, None
+
+        hidden, _ = jax.lax.scan(layer_step, hidden, params["layers"])
+        hidden = rms_norm(hidden, params["final_norm"], c.norm_eps)
+        return hidden @ params["unembed"]
+
+    param_shardings = jax.tree_util.tree_map(
+        plan.shard, llama.partition_specs(c))
+    token_sharding = plan.shard(P(("dp", "fsdp"), axis))
+    return jax.jit(forward,
+                   in_shardings=(param_shardings, token_sharding),
+                   out_shardings=plan.shard(P(("dp", "fsdp"), axis, None)))
+
+
+def make_long_context_loss(config: llama.LlamaConfig, plan: MeshPlan,
+                           attention: str = "ring", axis: str = "sp"):
+    """Next-token loss over sequence-sharded batches (for CP training)."""
+    forward = make_long_context_forward(config, plan, attention, axis)
+
+    def loss_fn(params, tokens):
+        logits = forward(params, tokens)[:, :-1, :].astype(jnp.float32)
+        targets = tokens[:, 1:]
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(log_probs, targets[..., None],
+                                     axis=-1)[..., 0]
+        return -picked.mean()
+
+    return loss_fn

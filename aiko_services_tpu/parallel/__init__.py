@@ -1,2 +1,4 @@
 from .mesh import (MeshPlan, make_mesh, submesh, device_inventory,
                    inventory_tags, virtual_cpu_devices, P, NamedSharding)
+from .ring import (ring_attention, ulysses_attention, blockwise_attention,
+                   ring_attention_sharded)
