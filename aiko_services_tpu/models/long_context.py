@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from . import llama
 from ..ops.layers import (apply_rope, repeat_kv, rms_norm,
-                          rope_frequencies, swiglu)
+                          rope_frequencies)
 from ..parallel.mesh import MeshPlan, P
 from ..parallel.ring import ring_attention, ulysses_attention
 
@@ -36,6 +36,9 @@ def make_long_context_forward(config: llama.LlamaConfig, plan: MeshPlan,
     if axis not in plan.mesh.axis_names:
         raise ValueError(f"mesh {dict(plan.mesh.shape)} has no '{axis}' "
                          f"axis for context parallelism")
+    if attention not in _ATTENTION:
+        raise ValueError(f"unknown attention scheme {attention!r}; "
+                         f"choose from {sorted(_ATTENTION)}")
     attn_fn = _ATTENTION[attention]
     c = config
     mesh = plan.mesh
@@ -45,28 +48,21 @@ def make_long_context_forward(config: llama.LlamaConfig, plan: MeshPlan,
 
     def forward(params, tokens):
         b, s = tokens.shape
-        hd = c.head_dim
-        rope_table = rope_frequencies(hd, c.max_seq, c.rope_theta)
+        rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         hidden = params["embed"][tokens]
 
-        def layer_step(hidden, layer):
-            x = rms_norm(hidden, layer["attn_norm"], c.norm_eps)
-            q = (x @ layer["wq"]).reshape(b, s, c.n_heads, hd)
-            k = (x @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
-            v = (x @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
+        def cp_attention(q, k, v, layer):
             q = apply_rope(q, rope_table, positions)
             k = apply_rope(k, rope_table, positions)
             k = repeat_kv(k, c.gqa_groups)
             v = repeat_kv(v, c.gqa_groups)
-            attn = attn_fn(q, k, v, positions, mesh, axis=axis,
+            return attn_fn(q, k, v, positions, mesh, axis=axis,
                            batch_axis=batch_axis, head_axis=head_axis)
-            hidden2 = hidden + attn.reshape(b, s, c.n_heads * hd) \
-                @ layer["wo"]
-            x2 = rms_norm(hidden2, layer["mlp_norm"], c.norm_eps)
-            hidden2 = hidden2 + swiglu(x2, layer["w_gate"],
-                                       layer["w_up"], layer["w_down"])
-            return hidden2, None
+
+        def layer_step(hidden, layer):
+            return llama._block(c, rope_table, hidden, layer,
+                                cp_attention), None
 
         hidden, _ = jax.lax.scan(layer_step, hidden, params["layers"])
         hidden = rms_norm(hidden, params["final_norm"], c.norm_eps)

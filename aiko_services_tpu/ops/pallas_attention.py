@@ -1,0 +1,175 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+Blockwise causal attention with online softmax -- the same math as
+``parallel.ring.blockwise_attention`` but scheduled by hand for the TPU
+memory hierarchy: Q/K/V tiles staged HBM->VMEM by the BlockSpec pipeline,
+S = Q.K^T on the MXU in float32, softmax statistics kept in VMEM scratch
+that persists across the KV grid axis, one output tile written on the
+last KV step.  GQA is handled in the index map (each query head reads its
+group's KV head) so K/V are never materialized repeated.
+
+On non-TPU backends the kernel runs in interpret mode, so tests exercise
+the identical code path on the CPU mesh (SURVEY.md section 4 strategy).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:                               # pragma: no cover
+    pltpu = None
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+_STAT_LANES = 128      # softmax stats replicated across the lane dim
+
+
+def _flash_kernel(offset_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *,
+                  block_q, block_k, scale, causal, kv_len):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qi = pl.program_id(1)
+    q_start = offset_ref[0] + qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: skip KV blocks strictly above this Q block's last row.
+    live = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                                    # [bq, d]
+        k = k_ref[0]                                    # [bk, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                           # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(mask, p, 0.0)
+        correction = jnp.exp(m_prev - m_safe)
+
+        l_new = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, d]
+        acc_scr[...] = acc_scr[...] * correction + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _round_up(n, multiple):
+    return -(-n // multiple) * multiple
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "block_q", "block_k", "interpret"))
+def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """Causal flash attention.
+
+    q: [B, S, H, d]; k/v: [B, T, Hkv, d] with H % Hkv == 0 (GQA: each
+    query head attends its group's KV head via the index map, no repeat
+    materialized).  ``q_offset`` is the absolute position of q row 0
+    (chunked prefill against a longer KV); it is a traced scalar, so
+    sweeping offsets does not recompile.  Returns [B, S, H, d] in q's
+    dtype; softmax in float32.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    t, h_kv = k.shape[1], k.shape[2]
+    groups = h // h_kv
+
+    # Blocks clamp to the (padded) sequence but stay sublane-aligned.
+    block_q = min(block_q, _round_up(max(s, 8), 8))
+    block_k = min(block_k, _round_up(max(t, 8), 8))
+
+    # [B, S, H, d] -> [B*H, S, d] rows; KV -> [B*Hkv, T, d].
+    q_r = _pad_to(q.transpose(0, 2, 1, 3).reshape(b * h, s, d),
+                  1, block_q)
+    k_r = _pad_to(k.transpose(0, 2, 1, 3).reshape(b * h_kv, t, d),
+                  1, block_k)
+    v_r = _pad_to(v.transpose(0, 2, 1, 3).reshape(b * h_kv, t, d),
+                  1, block_k)
+    s_pad, t_pad = q_r.shape[1], k_r.shape[1]
+
+    def kv_row(bh):
+        return (bh // h) * h_kv + (bh % h) // groups
+
+    grid = (b * h, s_pad // block_q, t_pad // block_k)
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k,
+        scale=d ** -0.5, causal=causal, kv_len=t)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, qi, ki, offset: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ki, offset: (kv_row(bh), ki, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, ki, offset: (kv_row(bh), ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki, offset: (bh, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    offset = jnp.asarray([q_offset], dtype=jnp.int32)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
+        interpret=interpret,
+    )(offset, q_r, k_r, v_r)
+
+    return out[:, :s, :].reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -125,16 +125,20 @@ def _ring_inner(q, k, v, q_pos, kv_pos, axis_name, axis_size):
 
     def body(carry, _):
         (k_cur, v_cur, pos_cur), (m, l, o) = carry
-        m, l, o = _online_block(q, k_cur, v_cur, q_pos, pos_cur, m, l, o)
-        # Rotate K/V to the next device while this hop's FLOPs retire;
-        # on TPU the ppermute rides ICI and XLA overlaps it with compute.
+        # Launch the rotation to the next device, then accumulate the
+        # current block -- the ppermute is independent of the block's
+        # FLOPs, so on TPU it rides ICI overlapped with compute.
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         pos_next = jax.lax.ppermute(pos_cur, axis_name, perm)
+        m, l, o = _online_block(q, k_cur, v_cur, q_pos, pos_cur, m, l, o)
         return ((k_next, v_next, pos_next), (m, l, o)), None
 
-    ((_, _, _), (m, l, o)), _ = jax.lax.scan(
-        body, ((k, v, kv_pos), init_stats), None, length=axis_size)
+    # n-1 rotate+accumulate hops, then the last arriving block is
+    # accumulated without a wasted final ppermute.
+    ((k_last, v_last, pos_last), stats), _ = jax.lax.scan(
+        body, ((k, v, kv_pos), init_stats), None, length=axis_size - 1)
+    m, l, o = _online_block(q, k_last, v_last, q_pos, pos_last, *stats)
     return _finish(l, o, q.dtype)
 
 

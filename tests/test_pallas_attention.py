@@ -1,0 +1,82 @@
+"""Pallas flash attention (interpret mode on CPU) == dense attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.ops import attention_prefill, repeat_kv
+from aiko_services_tpu.ops.pallas_attention import flash_attention
+
+
+def _dense(q, k, v, q_offset=0):
+    b, s = q.shape[:2]
+    positions = q_offset + jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    return attention_prefill(q, k, v, positions)
+
+
+def test_flash_matches_dense():
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (2, 64, 4, 16))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (2, 64, 4, 16))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (2, 64, 4, 16))
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    np.testing.assert_allclose(out, _dense(q, k, v), atol=1e-5)
+
+
+def test_flash_gqa_index_map():
+    """4 query heads over 2 KV heads -- no repeated KV materialization."""
+    key = jax.random.PRNGKey(3)
+    q = jax.random.normal(key, (2, 32, 4, 16))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (2, 32, 2, 16))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (2, 32, 2, 16))
+    out = flash_attention(q, k, v, block_q=8, block_k=8)
+    dense = _dense(q, repeat_kv(k, 2), repeat_kv(v, 2))
+    np.testing.assert_allclose(out, dense, atol=1e-5)
+
+
+def test_flash_ragged_lengths():
+    """S and T not multiples of the block sizes (pad/mask path)."""
+    key = jax.random.PRNGKey(4)
+    q = jax.random.normal(key, (1, 37, 2, 16))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (1, 37, 2, 16))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (1, 37, 2, 16))
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    np.testing.assert_allclose(out, _dense(q, k, v), atol=1e-5)
+
+
+def test_flash_chunked_prefill_offset():
+    """Queries begin at absolute position 24 against a 56-long KV."""
+    key = jax.random.PRNGKey(5)
+    q = jax.random.normal(key, (1, 32, 2, 16))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (1, 56, 2, 16))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (1, 56, 2, 16))
+    out = flash_attention(q, k, v, q_offset=24, block_q=16, block_k=16)
+    np.testing.assert_allclose(out, _dense(q, k, v, q_offset=24),
+                               atol=1e-5)
+
+
+def test_flash_non_causal():
+    key = jax.random.PRNGKey(6)
+    q = jax.random.normal(key, (1, 16, 2, 16))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (1, 16, 2, 16))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (1, 16, 2, 16))
+    out = flash_attention(q, k, v, causal=False, block_q=8, block_k=8)
+    scale = 16 ** -0.5
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    dense = jnp.einsum("bhst,bthd->bshd", jax.nn.softmax(logits, -1), v)
+    np.testing.assert_allclose(out, dense, atol=1e-5)
+
+
+def test_flash_bfloat16():
+    key = jax.random.PRNGKey(7)
+    q = jax.random.normal(key, (2, 32, 4, 16), dtype=jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (2, 32, 4, 16),
+                          dtype=jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (2, 32, 4, 16),
+                          dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(_dense(q, k, v), dtype=np.float32), atol=6e-2)
