@@ -1,0 +1,247 @@
+"""TPU data-plane substrate: tensor frames, shape bucketing, jit caches,
+stage placement on device submeshes (SURVEY.md section 7 step 5).
+
+In the reference, frames crossing stages are S-expressions over MQTT and
+bulk data rides ZMQ (reference main/pipeline.py:1328-1347,
+elements/media/scheme_zmq.py:40-150).  Here the data plane is TPU-native:
+
+- swag values are ``jax.Array``s resident in HBM between elements;
+- a stage is *placed* on a submesh of the local chips
+  (``StagePlacement``), and frames hop stages by ``jax.device_put`` --
+  resharding over ICI, never through the host;
+- XLA recompilation is controlled by bucketing dynamic shapes
+  (``ShapeBucketer``) and by per-element compiled-function caches keyed
+  on abstract shapes (``JitCache``);
+- only when a frame must leave the process (remote stage over the
+  control plane, ZMQ scheme) is it encoded host-side
+  (``encode_array``/``decode_array``).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import MeshPlan, P, make_mesh
+from .element import PipelineElement
+from .stream import Stream, StreamEvent
+
+__all__ = ["ShapeBucketer", "JitCache", "StagePlacement", "TPUElement",
+           "encode_array", "decode_array", "tree_device_put"]
+
+
+# ---------------------------------------------------------------------------
+# Shape bucketing: dynamic sizes -> small set of compiled shapes.
+
+class ShapeBucketer:
+    """Round ragged dimensions up to a bucket so XLA compiles once per
+    bucket instead of once per length (SURVEY.md section 7 "shape
+    polymorphism" hard part).
+
+    Default buckets are powers of two from ``minimum``; an explicit
+    bucket list wins.  ``pad(array, axis)`` returns (padded, true_size).
+    """
+
+    def __init__(self, buckets: Sequence[int] | None = None,
+                 minimum: int = 16, maximum: int = 1 << 20):
+        self._buckets = sorted(buckets) if buckets else None
+        self._minimum = minimum
+        self._maximum = maximum
+
+    def bucket(self, size: int) -> int:
+        if self._buckets:
+            for b in self._buckets:
+                if size <= b:
+                    return b
+            raise ValueError(f"size {size} exceeds largest bucket "
+                             f"{self._buckets[-1]}")
+        b = self._minimum
+        while b < size:
+            b <<= 1
+            if b > self._maximum:
+                raise ValueError(f"size {size} exceeds maximum bucket")
+        return b
+
+    def pad(self, array, axis: int = 0, fill=0):
+        size = array.shape[axis]
+        target = self.bucket(size)
+        if target == size:
+            return array, size
+        widths = [(0, 0)] * array.ndim
+        widths[axis] = (0, target - size)
+        return jnp.pad(array, widths, constant_values=fill), size
+
+
+# ---------------------------------------------------------------------------
+# Per-element compiled-function cache.
+
+class JitCache:
+    """Cache ``jax.jit`` computations keyed on input avals.
+
+    ``cache(fn)(*args)`` compiles once per distinct (shape, dtype)
+    signature and replays thereafter; ``stats`` exposes hit/miss counts
+    for the Metrics element.  Donation and shardings pass through to
+    ``jax.jit``.
+    """
+
+    def __init__(self, **jit_kwargs):
+        self._jit_kwargs = jit_kwargs
+        self._compiled: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    def _key(self, fn, args, kwargs):
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig = tuple(
+            (leaf.shape, str(leaf.dtype)) if hasattr(leaf, "shape")
+            else repr(leaf) for leaf in leaves)
+        return (id(fn), treedef, sig)
+
+    def __call__(self, fn: Callable) -> Callable:
+        jitted = jax.jit(fn, **self._jit_kwargs)
+
+        def wrapper(*args, **kwargs):
+            key = self._key(fn, args, kwargs)
+            if key in self._compiled:
+                self.hits += 1
+            else:
+                self.misses += 1
+                self._compiled[key] = True
+            return jitted(*args, **kwargs)
+
+        wrapper.jitted = jitted
+        return wrapper
+
+    @property
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "signatures": len(self._compiled)}
+
+
+# ---------------------------------------------------------------------------
+# Stage placement: pipeline stages onto disjoint chip submeshes.
+
+class StagePlacement:
+    """Carve the local device set into per-stage submeshes.
+
+    The reference deploys stages into other OS processes found by
+    ServiceFilter (reference pipeline.py:246-258); on TPU a stage lands
+    on a group of local chips instead.  ``assign`` partitions devices
+    contiguously (contiguity = ICI neighbours on a pod) and returns a
+    ``MeshPlan`` per stage; ``transfer`` reshards a frame's tensors onto
+    the next stage's mesh -- on TPU this is a pure ICI copy.
+    """
+
+    def __init__(self, devices: Sequence | None = None):
+        self.devices = list(devices if devices is not None
+                            else jax.devices())
+        self.plans: dict[str, MeshPlan] = {}
+
+    def assign(self, stages: dict[str, dict[str, int] | int]) \
+            -> dict[str, MeshPlan]:
+        """stages: name -> chip count or {axis: size} mesh request."""
+        requests = {}
+        for name, want in stages.items():
+            axes = {"dp": want} if isinstance(want, int) else dict(want)
+            count = int(np.prod(list(axes.values())))
+            requests[name] = (axes, count)
+        total = sum(count for _, count in requests.values())
+        if total > len(self.devices):
+            raise ValueError(
+                f"stages want {total} devices, have {len(self.devices)}")
+        cursor = 0
+        for name, (axes, count) in requests.items():
+            chunk = self.devices[cursor:cursor + count]
+            cursor += count
+            self.plans[name] = MeshPlan(make_mesh(axes, chunk))
+        return self.plans
+
+    def plan(self, stage: str) -> MeshPlan:
+        return self.plans[stage]
+
+    def transfer(self, value, to_stage: str, *spec):
+        """Reshard ``value`` (array or pytree) onto a stage's mesh."""
+        plan = self.plans[to_stage]
+        sharding = plan.shard(*spec) if spec else plan.replicated()
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, sharding)
+            if hasattr(leaf, "shape") else leaf, value)
+
+
+def tree_device_put(tree, plan: MeshPlan, spec: P | None = None):
+    """device_put every array leaf of a swag/pytree onto ``plan``."""
+    sharding = plan.shard(spec) if spec is not None else plan.replicated()
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(leaf, sharding)
+        if hasattr(leaf, "shape") else leaf, tree)
+
+
+# ---------------------------------------------------------------------------
+# Host-side array codec (only for frames leaving the process).
+
+def encode_array(array) -> bytes:
+    """jax/numpy array -> self-describing bytes (npy format)."""
+    buffer = io.BytesIO()
+    np.save(buffer, np.asarray(array), allow_pickle=False)
+    return buffer.getvalue()
+
+
+def decode_array(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+# ---------------------------------------------------------------------------
+# TPU element base class.
+
+class TPUElement(PipelineElement):
+    """PipelineElement hosting jitted computation on a device mesh.
+
+    Placement resolves from the ``placement`` parameter: ``"local"``
+    (all local devices, default), a mesh request like
+    ``{"dp": 2, "tp": 4}``, or a stage name previously assigned on the
+    pipeline's StagePlacement.  Subclasses use ``self.jit`` for
+    shape-keyed compiled caches and ``self.plan`` for shardings.
+    """
+
+    def __init__(self, context):
+        super().__init__(context)
+        self._plan: MeshPlan | None = None
+        self.jit_cache = JitCache()
+        self.bucketer = ShapeBucketer()
+
+    @property
+    def plan(self) -> MeshPlan:
+        if self._plan is None:
+            self._plan = self._resolve_placement()
+        return self._plan
+
+    def _resolve_placement(self) -> MeshPlan:
+        placement, _ = self.get_parameter("placement", "local")
+        placements = getattr(self.pipeline, "stage_placement", None)
+        if isinstance(placement, str) and placements is not None \
+                and placement in placements.plans:
+            return placements.plan(placement)
+        if isinstance(placement, dict):
+            return MeshPlan(make_mesh(dict(placement)))
+        devices = jax.devices()
+        return MeshPlan(make_mesh({"dp": len(devices)}, devices))
+
+    def jit(self, fn: Callable) -> Callable:
+        """Shape-keyed compiled cache for this element."""
+        return self.jit_cache(fn)
+
+    def put(self, value, *spec):
+        """Place an array (or pytree) on this element's mesh."""
+        sharding = (self.plan.shard(*spec) if spec
+                    else self.plan.replicated())
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, sharding)
+            if hasattr(leaf, "shape") else leaf, value)
+
+    def metrics(self) -> dict:
+        return {"jit": self.jit_cache.stats,
+                "mesh": dict(self.plan.mesh.shape)}

@@ -3,6 +3,7 @@ tests/unit/test_pipeline_graph.py elements A/B/C and
 examples/pipeline/elements.py PE_0..PE_4)."""
 
 from aiko_services_tpu.pipeline import PipelineElement, StreamEvent
+from aiko_services_tpu.pipeline.tensor import TPUElement
 
 
 class ElementA(PipelineElement):
@@ -56,3 +57,26 @@ class Counter(PipelineElement):
 class Stopper(PipelineElement):
     def process_frame(self, stream, **inputs):
         return StreamEvent.STOP, {}
+
+
+class TensorScale(TPUElement):
+    """TPU element: x -> x * factor on the element's mesh, jit-cached."""
+
+    def __init__(self, context):
+        super().__init__(context)
+        self._scale = self.jit(lambda x, f: x * f)
+
+    def process_frame(self, stream, x):
+        factor, _ = self.get_parameter("factor", 2.0)
+        return StreamEvent.OKAY, {"x": self._scale(x, float(factor))}
+
+
+class TensorSum(TPUElement):
+    """Reduce x to a scalar jax array."""
+
+    def __init__(self, context):
+        super().__init__(context)
+        self._sum = self.jit(lambda x: x.sum())
+
+    def process_frame(self, stream, x):
+        return StreamEvent.OKAY, {"total": self._sum(x)}

@@ -1,0 +1,163 @@
+"""TPU data-plane substrate: bucketing, jit caches, stage placement,
+tensor frames flowing through a real pipeline."""
+
+import queue
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import run_until
+from aiko_services_tpu.pipeline import Pipeline
+from aiko_services_tpu.pipeline.tensor import (
+    JitCache, ShapeBucketer, StagePlacement, decode_array, encode_array,
+    tree_device_put)
+from aiko_services_tpu.parallel import MeshPlan, P, make_mesh
+
+ELEMENTS = "tests/pipeline_elements.py"
+
+
+def element(name, cls, inputs, outputs, parameters=None):
+    return {"name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "deploy": {"local": {"module": ELEMENTS, "class_name": cls}},
+            "parameters": parameters or {}}
+
+
+def definition(graph, elements, name="p_tensor"):
+    return {"version": 0, "name": name, "runtime": "jax", "graph": graph,
+            "parameters": {}, "elements": elements}
+
+
+# -- ShapeBucketer ----------------------------------------------------------
+
+def test_bucketer_powers_of_two():
+    b = ShapeBucketer(minimum=16)
+    assert b.bucket(1) == 16
+    assert b.bucket(16) == 16
+    assert b.bucket(17) == 32
+    assert b.bucket(1000) == 1024
+
+
+def test_bucketer_explicit_buckets():
+    b = ShapeBucketer(buckets=[8, 64, 512])
+    assert b.bucket(5) == 8
+    assert b.bucket(64) == 64
+    assert b.bucket(65) == 512
+    with pytest.raises(ValueError):
+        b.bucket(513)
+
+
+def test_bucketer_pad():
+    b = ShapeBucketer(buckets=[8])
+    x = jnp.arange(5)
+    padded, true_size = b.pad(x)
+    assert padded.shape == (8,)
+    assert true_size == 5
+    np.testing.assert_array_equal(np.asarray(padded),
+                                  [0, 1, 2, 3, 4, 0, 0, 0])
+
+
+# -- JitCache ---------------------------------------------------------------
+
+def test_jit_cache_hits_and_misses():
+    cache = JitCache()
+    fn = cache(lambda x: x * 2)
+    fn(jnp.ones((4,)))
+    fn(jnp.ones((4,)))          # same signature -> hit
+    fn(jnp.ones((8,)))          # new shape -> miss
+    assert cache.stats == {"hits": 1, "misses": 2, "signatures": 2}
+
+
+def test_jit_cache_bucketed_no_recompile():
+    """Bucketing keeps ragged lengths on one compiled signature."""
+    cache = JitCache()
+    bucketer = ShapeBucketer(buckets=[8])
+    fn = cache(lambda x: x.sum())
+    for n in (3, 5, 7):
+        padded, _ = bucketer.pad(jnp.ones((n,)))
+        fn(padded)
+    assert cache.stats["signatures"] == 1
+
+
+# -- StagePlacement ---------------------------------------------------------
+
+def test_stage_placement_disjoint_submeshes():
+    placement = StagePlacement(jax.devices())
+    plans = placement.assign({"detect": {"dp": 2},
+                              "llm": {"tp": 4},
+                              "post": 2})
+    all_devices = []
+    for plan in plans.values():
+        all_devices += list(plan.mesh.devices.flat)
+    assert len(all_devices) == 8
+    assert len(set(all_devices)) == 8          # disjoint
+    assert dict(plans["llm"].mesh.shape) == {"tp": 4}
+
+
+def test_stage_placement_overflow_rejected():
+    placement = StagePlacement(jax.devices())
+    with pytest.raises(ValueError, match="want"):
+        placement.assign({"a": 8, "b": 1})
+
+
+def test_stage_transfer_reshards():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"a": {"dp": 4}, "b": {"tp": 4}})
+    x = jnp.arange(16.0).reshape(4, 4)
+    on_a = placement.transfer(x, "a", P("dp", None))
+    on_b = placement.transfer(on_a, "b", P(None, "tp"))
+    np.testing.assert_array_equal(np.asarray(on_b), np.asarray(x))
+    assert on_b.sharding.mesh.shape["tp"] == 4
+
+
+def test_tree_device_put():
+    plan = MeshPlan(make_mesh({"dp": 4}, jax.devices()[:4]))
+    tree = {"x": jnp.ones((8, 2)), "meta": "keep-me"}
+    placed = tree_device_put(tree, plan, P("dp", None))
+    assert placed["meta"] == "keep-me"
+    assert placed["x"].sharding.mesh.shape["dp"] == 4
+
+
+# -- host codec -------------------------------------------------------------
+
+def test_array_codec_roundtrip():
+    x = np.random.default_rng(0).standard_normal((3, 5)).astype("float32")
+    decoded = decode_array(encode_array(jnp.asarray(x)))
+    np.testing.assert_array_equal(decoded, x)
+    assert decoded.dtype == x.dtype
+
+
+# -- tensor frames through a real pipeline ----------------------------------
+
+def test_tensor_pipeline_end_to_end(runtime):
+    """jax.Arrays flow through TPU elements; jit cache reused across
+    frames."""
+    pipeline = Pipeline(definition(
+        ["(Scale Sum)"],
+        [element("Scale", "TensorScale", ["x"], ["x"],
+                 {"factor": 3.0}),
+         element("Sum", "TensorSum", ["x"], ["total"])]),
+        runtime=runtime)
+
+    def run_frame(value):
+        responses = queue.Queue()
+        pipeline.process_frame_local({"x": value},
+                                     queue_response=responses)
+        run_until(runtime, lambda: not responses.empty())
+        *_, swag, metrics, okay, diagnostic = \
+            (lambda t: (t[0], t[1], t[2], t[3], t[4], t[5]))(
+                responses.get())
+        assert okay, diagnostic
+        return swag
+
+    swag = run_frame(jnp.ones((4, 4)))
+    assert float(swag["total"]) == 48.0
+    swag = run_frame(jnp.ones((4, 4)) * 2)
+    assert float(swag["total"]) == 96.0
+
+    scale = pipeline.graph.get_node("Scale").element
+    assert scale.jit_cache.stats["hits"] >= 1
+    assert scale.jit_cache.stats["signatures"] == 1
