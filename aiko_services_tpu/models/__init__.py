@@ -1,4 +1,5 @@
 from . import llama
 from . import long_context
 from .batching import ContinuousBatcher, Request
+from .checkpoint import Checkpointer, save_pytree, restore_pytree
 from .tokenizer import ByteTokenizer, load_tokenizer
