@@ -1,7 +1,18 @@
 from .common import Mock, NoOp, Identity, Terminate
 from .scheme_file import DataSchemeFile
+from .scheme_zmq import (DataSchemeZMQ, TextReadZMQ, TextWriteZMQ,
+                         ImageReadZMQ, ImageWriteZMQ)
+from .scheme_tty import DataSchemeTTY, TextReadTTY, TextWriteTTY
 from .text import (TextReadFile, TextWriteFile, TextTransform, TextSample,
                    TextOutput)
+from .image import (ImageReadFile, ImageWriteFile, ImageResize,
+                    ImageOverlay, ImageOutput, image_to_array,
+                    array_to_image)
+from .video import (VideoReadFile, VideoWriteFile, VideoSample,
+                    VideoOutput, VideoReadWebcam)
+from .audio import (AudioReadFile, AudioWriteFile, AudioFraming,
+                    AudioResampler, AudioFFT, AudioOutput, read_wav,
+                    write_wav)
 from .observe import Inspect, Metrics
 from .expression import Expression, AllOutputs, evaluate_expression
 from .control import Loop

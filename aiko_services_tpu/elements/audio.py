@@ -1,0 +1,158 @@
+"""Audio elements (reference: src/aiko_services/elements/media/
+audio_io.py): file read/write, framing, filter, resample, FFT.
+
+File I/O uses the stdlib ``wave`` module (PCM16 WAV -- soundfile is not
+in this environment; the reference used soundfile/pyaudio/sounddevice,
+audio_io.py:75-205).  All DSP -- windowing, resampling, FFT -- runs as
+jax ops on device instead of numpy on host.
+"""
+
+from __future__ import annotations
+
+import os
+import wave
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..pipeline import DataSource, DataTarget, PipelineElement, StreamEvent
+from .scheme_file import DataSchemeFile
+
+__all__ = ["AudioReadFile", "AudioWriteFile", "AudioFraming",
+           "AudioResampler", "AudioFFT", "AudioOutput",
+           "read_wav", "write_wav"]
+
+
+def read_wav(path) -> tuple[np.ndarray, int]:
+    """PCM16 WAV -> (float32 samples [N, C] in -1..1, sample_rate)."""
+    with wave.open(os.fspath(path), "rb") as fh:
+        rate = fh.getframerate()
+        channels = fh.getnchannels()
+        width = fh.getsampwidth()
+        raw = fh.readframes(fh.getnframes())
+    if width != 2:
+        raise ValueError(f"{path}: only PCM16 WAV supported, got "
+                         f"{8 * width}-bit")
+    samples = np.frombuffer(raw, dtype="<i2").astype(np.float32) / 32768.0
+    return samples.reshape(-1, channels), rate
+
+
+def write_wav(path, samples, rate: int):
+    """float32 samples [N] or [N, C] in -1..1 -> PCM16 WAV."""
+    array = np.asarray(samples, dtype=np.float32)
+    if array.ndim == 1:
+        array = array[:, None]
+    data = (np.clip(array, -1.0, 1.0) * 32767.0).astype("<i2")
+    with wave.open(os.fspath(path), "wb") as fh:
+        fh.setnchannels(array.shape[1])
+        fh.setsampwidth(2)
+        fh.setframerate(int(rate))
+        fh.writeframes(data.tobytes())
+
+
+class AudioReadFile(DataSource):
+    """Reads WAV file(s); emits ``audio`` [N, C] jax array +
+    ``sample_rate`` (reference audio_io.py:95-205)."""
+
+    def process_frame(self, stream, **inputs):
+        path = inputs.get("path")
+        try:
+            samples, rate = read_wav(path)
+        except (OSError, ValueError, wave.Error) as error:
+            return StreamEvent.ERROR, {"diagnostic": str(error)}
+        return StreamEvent.OKAY, {"audio": jnp.asarray(samples),
+                                  "sample_rate": rate, "path": path}
+
+
+class AudioWriteFile(DataTarget):
+    """Writes ``audio`` to a WAV path (reference speech_elements.py:88)."""
+
+    def process_frame(self, stream, audio=None, sample_rate=16000,
+                      **inputs):
+        scheme = self.scheme_for(stream)
+        if not isinstance(scheme, DataSchemeFile):
+            return StreamEvent.ERROR, {
+                "diagnostic": "AudioWriteFile requires file:// targets"}
+        path = scheme.target_path(stream)
+        try:
+            write_wav(path, audio, int(sample_rate))
+        except (OSError, ValueError, wave.Error) as error:
+            return StreamEvent.ERROR, {"diagnostic": str(error)}
+        return StreamEvent.OKAY, {"path": path}
+
+
+class AudioFraming(PipelineElement):
+    """Splits ``audio`` into fixed windows with hop (sliding window like
+    the reference's LRU audio framing, speech_elements.py:53-84); emits
+    ``frames`` [num_windows, window, C]."""
+
+    def process_frame(self, stream, audio=None, sample_rate=16000,
+                      **inputs):
+        window, _ = self.get_parameter("window", 400)
+        hop, _ = self.get_parameter("hop", 160)
+        window, hop = int(window), int(hop)
+        audio = jnp.asarray(audio)
+        if audio.ndim == 1:
+            audio = audio[:, None]
+        n = audio.shape[0]
+        if n < window:
+            audio = jnp.pad(audio, ((0, window - n), (0, 0)))
+            n = window
+        starts = jnp.arange(0, n - window + 1, hop)
+        frames = jax.vmap(
+            lambda s: jax.lax.dynamic_slice_in_dim(audio, s, window))(
+            starts)
+        return StreamEvent.OKAY, {"frames": frames,
+                                  "sample_rate": sample_rate}
+
+
+class AudioResampler(PipelineElement):
+    """Linear resample to ``target_rate`` -- jax on device (reference
+    audio_io.py:237-299 used numpy)."""
+
+    def process_frame(self, stream, audio=None, sample_rate=16000,
+                      **inputs):
+        target, _ = self.get_parameter("target_rate", 16000)
+        target = int(target)
+        rate = int(sample_rate)
+        audio = jnp.asarray(audio)
+        if rate == target:
+            return StreamEvent.OKAY, {"audio": audio,
+                                      "sample_rate": target}
+        squeeze = audio.ndim == 1
+        if squeeze:
+            audio = audio[:, None]
+        new_length = int(round(audio.shape[0] * target / rate))
+        resampled = jax.image.resize(
+            audio.astype(jnp.float32), (new_length, audio.shape[1]),
+            method="linear")
+        if squeeze:
+            resampled = resampled[:, 0]
+        return StreamEvent.OKAY, {"audio": resampled,
+                                  "sample_rate": target}
+
+
+class AudioFFT(PipelineElement):
+    """Magnitude spectrum per window of ``frames`` (reference
+    audio_io.py:299-334's PE_FFT)."""
+
+    def process_frame(self, stream, frames=None, sample_rate=16000,
+                      **inputs):
+        frames = jnp.asarray(frames)
+        mono = frames.mean(axis=-1) if frames.ndim == 3 else frames
+        spectrum = jnp.abs(jnp.fft.rfft(mono.astype(jnp.float32),
+                                        axis=-1))
+        return StreamEvent.OKAY, {"spectrum": spectrum,
+                                  "sample_rate": sample_rate}
+
+
+class AudioOutput(PipelineElement):
+    """Logs audio shape; passthrough (reference audio_io.py:75-95)."""
+
+    def process_frame(self, stream, audio=None, **inputs):
+        if audio is not None:
+            self.logger.info("audio %s", tuple(getattr(audio, "shape",
+                                                       ())))
+        return StreamEvent.OKAY, {"audio": audio}

@@ -1,0 +1,159 @@
+"""Image elements (reference: src/aiko_services/elements/media/
+image_io.py): read/resize/overlay/write with TPU-native compute.
+
+Decode/encode is host-side (PIL); everything numeric -- resize,
+normalize, overlay compositing -- runs as jax ops so image tensors stay
+on device between elements (the reference does all of this on the CPU
+with PIL/cv2, image_io.py:104-148,343-371).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    from PIL import Image, ImageDraw
+    _HAVE_PIL = True
+except ImportError:                                 # pragma: no cover
+    _HAVE_PIL = False
+
+import jax
+import jax.numpy as jnp
+
+from ..pipeline import DataSource, DataTarget, PipelineElement, StreamEvent
+from .scheme_file import DataSchemeFile
+
+__all__ = ["ImageReadFile", "ImageWriteFile", "ImageResize",
+           "ImageOverlay", "ImageOutput", "image_to_array",
+           "array_to_image"]
+
+
+def image_to_array(image) -> np.ndarray:
+    """PIL Image -> uint8 numpy [H, W, C] (reference image_io.py:104-125
+    conversion helpers)."""
+    array = np.asarray(image)
+    if array.ndim == 2:
+        array = array[:, :, None]
+    return array
+
+
+def array_to_image(array):
+    """numpy/jax array [H, W, C] (uint8 or float 0..1) -> PIL Image."""
+    if not _HAVE_PIL:
+        raise RuntimeError("Pillow is not installed")
+    array = np.asarray(array)
+    if array.dtype != np.uint8:
+        array = (np.clip(array, 0.0, 1.0) * 255).astype(np.uint8)
+    if array.ndim == 3 and array.shape[-1] == 1:
+        array = array[:, :, 0]
+    return Image.fromarray(array)
+
+
+class ImageReadFile(DataSource):
+    """Reads image file(s) from ``data_sources``; emits ``image`` as a
+    uint8 jax array [H, W, C] (reference image_io.py:278-307)."""
+
+    def process_frame(self, stream, **inputs):
+        path = inputs.get("path")
+        if not _HAVE_PIL:
+            return StreamEvent.ERROR, {"diagnostic": "Pillow missing"}
+        try:
+            with Image.open(path) as image:
+                array = image_to_array(image.convert("RGB"))
+        except OSError as error:
+            return StreamEvent.ERROR, {"diagnostic": str(error)}
+        return StreamEvent.OKAY, {"image": jnp.asarray(array),
+                                  "path": path}
+
+
+class ImageWriteFile(DataTarget):
+    """Writes ``image`` to ``data_targets`` path; ``{}`` templates get the
+    frame index (reference image_io.py:372-407)."""
+
+    def process_frame(self, stream, image=None, **inputs):
+        scheme = self.scheme_for(stream)
+        if not isinstance(scheme, DataSchemeFile):
+            return StreamEvent.ERROR, {
+                "diagnostic": "ImageWriteFile requires file:// targets"}
+        path = scheme.target_path(stream)
+        try:
+            array_to_image(image).save(path)
+        except (OSError, ValueError) as error:
+            return StreamEvent.ERROR, {"diagnostic": str(error)}
+        return StreamEvent.OKAY, {"path": path}
+
+
+class ImageResize(PipelineElement):
+    """Resize ``image`` to ``width`` x ``height`` parameters -- jax
+    bilinear resize, on-device (reference image_io.py:343-371 does PIL
+    resize on host)."""
+
+    def __init__(self, context):
+        super().__init__(context)
+        self._resize = jax.jit(
+            lambda x, h, w: jax.image.resize(
+                x.astype(jnp.float32),
+                (h, w) + x.shape[2:], method="bilinear"),
+            static_argnums=(1, 2))
+
+    def process_frame(self, stream, image=None, **inputs):
+        width, _ = self.get_parameter("width")
+        height, _ = self.get_parameter("height")
+        if not width or not height:
+            return StreamEvent.ERROR, {
+                "diagnostic": "ImageResize needs width/height parameters"}
+        image = jnp.asarray(image)
+        resized = self._resize(image, int(height), int(width))
+        if image.dtype == jnp.uint8:
+            resized = jnp.clip(jnp.round(resized), 0, 255) \
+                .astype(jnp.uint8)
+        return StreamEvent.OKAY, {"image": resized}
+
+
+class ImageOverlay(PipelineElement):
+    """Draw detection overlays onto ``image``.
+
+    ``overlay`` is ``{"rectangles": [{"x": .., "y": .., "w": .., "h": ..,
+    "name": ..}], "texts": [...]}`` in relative (0..1) or absolute pixel
+    coordinates (reference image_io.py:164-234 draws via PIL on host; the
+    boxes here are drawn host-side too -- rectangles are tiny -- but the
+    image returns as a jax array so the pipeline stays tensor-native).
+    """
+
+    def process_frame(self, stream, image=None, overlay=None, **inputs):
+        if overlay is None:
+            return StreamEvent.OKAY, {"image": image}
+        if not _HAVE_PIL:
+            return StreamEvent.ERROR, {"diagnostic": "Pillow missing"}
+        pil = array_to_image(image)
+        if pil.mode != "RGB":
+            pil = pil.convert("RGB")
+        draw = ImageDraw.Draw(pil)
+        h, w = pil.height, pil.width
+        color, _ = self.get_parameter("color", "red")
+        for rect in overlay.get("rectangles", []):
+            x, y = float(rect["x"]), float(rect["y"])
+            rw, rh = float(rect["w"]), float(rect["h"])
+            if max(x, y, rw, rh) <= 1.0:        # relative coordinates
+                x, y, rw, rh = x * w, y * h, rw * w, rh * h
+            draw.rectangle([x, y, x + rw, y + rh], outline=color,
+                           width=2)
+            name = rect.get("name")
+            if name:
+                draw.text((x + 2, max(0, y - 12)), str(name), fill=color)
+        for text in overlay.get("texts", []):
+            draw.text((float(text.get("x", 4)), float(text.get("y", 4))),
+                      str(text.get("text", "")), fill=color)
+        return StreamEvent.OKAY, {"image": jnp.asarray(np.asarray(pil))}
+
+
+class ImageOutput(PipelineElement):
+    """Logs image shape/dtype; passthrough (reference
+    image_io.py:149-163)."""
+
+    def process_frame(self, stream, image=None, **inputs):
+        if image is not None:
+            self.logger.info("image %s %s",
+                             tuple(getattr(image, "shape", ())),
+                             getattr(image, "dtype", type(image)))
+        return StreamEvent.OKAY, {"image": image}

@@ -1,0 +1,268 @@
+"""Media element library: image/video/audio I/O + ZMQ/TTY schemes running
+through real pipelines on the loopback runtime."""
+
+import io
+import queue
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import run_until
+from aiko_services_tpu.elements import read_wav, write_wav
+from aiko_services_tpu.pipeline import Pipeline
+
+MEDIA = "aiko_services_tpu.elements"
+
+
+def element(name, cls, inputs, outputs, parameters=None):
+    return {"name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "deploy": {"local": {"module": MEDIA, "class_name": cls}},
+            "parameters": parameters or {}}
+
+
+def definition(graph, elements, name="p_media"):
+    return {"version": 0, "name": name, "runtime": "jax", "graph": graph,
+            "parameters": {}, "elements": elements}
+
+
+def pump_stream(runtime, pipeline, stream_id="s1", parameters=None,
+                predicate=None, timeout=10.0):
+    pipeline.create_stream_local(stream_id, parameters or {})
+    if predicate is not None:
+        assert run_until(runtime, predicate, timeout=timeout)
+
+
+def make_image(tmp_path, name="in.png", size=(32, 24), color=(200, 30, 40)):
+    from PIL import Image
+    path = tmp_path / name
+    Image.new("RGB", size, color).save(path)
+    return path
+
+
+# -- image ------------------------------------------------------------------
+
+def test_image_read_resize_overlay_write(tmp_path, runtime):
+    source = make_image(tmp_path)
+    target = tmp_path / "out.png"
+    pipeline = Pipeline(definition(
+        ["(Read Resize Overlay Write)"],
+        [element("Read", "ImageReadFile", ["path"], ["image"],
+                 {"data_sources": f"file://{source}"}),
+         element("Resize", "ImageResize", ["image"], ["image"],
+                 {"width": 16, "height": 12}),
+         element("Overlay", "ImageOverlay", ["image"], ["image"]),
+         element("Write", "ImageWriteFile", ["image"], ["path"],
+                 {"data_targets": f"file://{target}"})]),
+        runtime=runtime)
+    pump_stream(runtime, pipeline, predicate=lambda: target.exists())
+
+    from PIL import Image
+    with Image.open(target) as image:
+        assert image.size == (16, 12)
+
+
+def test_image_overlay_draws_rectangles(runtime):
+    from aiko_services_tpu.elements.image import ImageOverlay
+    from aiko_services_tpu.pipeline.element import ElementContext
+
+    overlay = ImageOverlay(ElementContext(
+        "o", None, _FakePipeline(), {}))
+    image = jnp.zeros((20, 20, 3), dtype=jnp.uint8)
+    event, outputs = overlay.process_frame(
+        None, image=image,
+        overlay={"rectangles": [
+            {"x": 0.1, "y": 0.1, "w": 0.5, "h": 0.5, "name": "cat"}]})
+    out = np.asarray(outputs["image"])
+    assert out.sum() > 0                   # something was drawn
+
+
+class _FakePipeline:
+    def current_stream(self):
+        return None
+
+    def get_pipeline_parameter(self, name, default=None):
+        return default
+
+
+# -- video ------------------------------------------------------------------
+
+def test_video_write_then_read(tmp_path, runtime):
+    cv2 = pytest.importorskip("cv2")
+    video_path = tmp_path / "clip.avi"
+    frames = [np.full((24, 32, 3), i * 10, dtype=np.uint8)
+              for i in range(5)]
+    writer = cv2.VideoWriter(
+        str(video_path), cv2.VideoWriter_fourcc(*"MJPG"), 10.0, (32, 24))
+    assert writer.isOpened()
+    for frame in frames:
+        writer.write(frame)
+    writer.release()
+
+    collected = []
+
+    import tests_media_helpers  # registered collector element
+    tests_media_helpers.SINK = collected
+
+    pipeline = Pipeline(definition(
+        ["(Read Collect)"],
+        [element("Read", "VideoReadFile", ["image"], ["image"],
+                 {"data_sources": f"file://{video_path}"}),
+         {"name": "Collect", "input": [{"name": "image"}],
+          "output": [],
+          "deploy": {"local": {"module": "tests_media_helpers",
+                               "class_name": "Collect"}},
+          "parameters": {}}]),
+        runtime=runtime)
+    pump_stream(runtime, pipeline,
+                predicate=lambda: len(collected) >= 5)
+    assert collected[0].shape == (24, 32, 3)
+
+
+def test_video_sample_drops(runtime):
+    from aiko_services_tpu.elements.video import VideoSample
+    from aiko_services_tpu.pipeline.element import ElementContext
+    from aiko_services_tpu.pipeline.stream import Stream
+    from aiko_services_tpu.pipeline import StreamEvent
+
+    sampler = VideoSample(ElementContext(
+        "s", None, _FakePipeline(), {"sample_rate": 3}))
+    stream = Stream(stream_id="x")
+    sampler.start_stream(stream, "x")
+    events = [sampler.process_frame(stream, image=i)[0] for i in range(6)]
+    assert events == [StreamEvent.OKAY, StreamEvent.DROP_FRAME,
+                      StreamEvent.DROP_FRAME, StreamEvent.OKAY,
+                      StreamEvent.DROP_FRAME, StreamEvent.DROP_FRAME]
+
+
+# -- audio ------------------------------------------------------------------
+
+def test_wav_roundtrip(tmp_path):
+    rate = 8000
+    t = np.linspace(0, 1, rate, endpoint=False)
+    tone = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+    path = tmp_path / "tone.wav"
+    write_wav(path, tone, rate)
+    samples, read_rate = read_wav(str(path))
+    assert read_rate == rate
+    assert samples.shape == (rate, 1)
+    np.testing.assert_allclose(samples[:, 0], tone, atol=1e-3)
+
+
+def test_audio_pipeline_frame_fft(tmp_path, runtime):
+    rate = 8000
+    t = np.linspace(0, 0.1, rate // 10, endpoint=False)
+    tone = (0.5 * np.sin(2 * np.pi * 1000 * t)).astype(np.float32)
+    path = tmp_path / "in.wav"
+    write_wav(path, tone, rate)
+
+    import tests_media_helpers
+    collected = []
+    tests_media_helpers.SINK = collected
+
+    pipeline = Pipeline(definition(
+        ["(Read Frame FFT Collect)"],
+        [element("Read", "AudioReadFile", ["path"], ["audio", "sample_rate"],
+                 {"data_sources": f"file://{path}"}),
+         element("Frame", "AudioFraming", ["audio"], ["frames"],
+                 {"window": 256, "hop": 128}),
+         element("FFT", "AudioFFT", ["frames"], ["spectrum"]),
+         {"name": "Collect", "input": [{"name": "spectrum"}],
+          "output": [],
+          "deploy": {"local": {"module": "tests_media_helpers",
+                               "class_name": "CollectSpectrum"}},
+          "parameters": {}}]),
+        runtime=runtime)
+    pump_stream(runtime, pipeline, predicate=lambda: len(collected) >= 1)
+    spectrum = np.asarray(collected[0])
+    # peak bin should be at 1 kHz: bin = 1000 / (8000/256) = 32
+    assert abs(int(spectrum[0].argmax()) - 32) <= 1
+
+
+def test_audio_resampler():
+    from aiko_services_tpu.elements.audio import AudioResampler
+    from aiko_services_tpu.pipeline.element import ElementContext
+
+    resampler = AudioResampler(ElementContext(
+        "r", None, _FakePipeline(), {"target_rate": 4000}))
+    audio = jnp.ones((8000,), dtype=jnp.float32)
+    event, outputs = resampler.process_frame(None, audio=audio,
+                                             sample_rate=8000)
+    assert outputs["audio"].shape == (4000,)
+    assert outputs["sample_rate"] == 4000
+
+
+# -- zmq --------------------------------------------------------------------
+
+def test_zmq_array_payload_roundtrip():
+    from aiko_services_tpu.elements.scheme_zmq import (decode_payload,
+                                                       encode_payload)
+    x = jnp.arange(12.0).reshape(3, 4)
+    decoded = decode_payload(encode_payload(x))
+    np.testing.assert_array_equal(np.asarray(decoded), np.asarray(x))
+    assert decode_payload(encode_payload("hello")) == "hello"
+    assert decode_payload(encode_payload(b"raw")) == b"raw"
+
+
+def test_zmq_pipeline_pair(tmp_path, runtime):
+    """Writer pipeline PUSHes text, reader pipeline PULLs it."""
+    zmq = pytest.importorskip("zmq")
+    from aiko_services_tpu.utils import find_free_port
+    port = find_free_port()
+
+    import tests_media_helpers
+    collected = []
+    tests_media_helpers.SINK = collected
+
+    reader = Pipeline(definition(
+        ["(Read Collect)"],
+        [element("Read", "TextReadZMQ", ["payload"], ["text"],
+                 {"data_sources": f"zmq://127.0.0.1:{port}",
+                  "zmq_bind": True}),
+         {"name": "Collect", "input": [{"name": "text"}], "output": [],
+          "deploy": {"local": {"module": "tests_media_helpers",
+                               "class_name": "CollectText"}},
+          "parameters": {}}], name="p_zmq_read"),
+        runtime=runtime)
+    reader.create_stream_local("rx", {})
+
+    writer = Pipeline(definition(
+        ["(Write)"],
+        [element("Write", "TextWriteZMQ", ["text"], ["text"],
+                 {"data_targets": f"zmq://127.0.0.1:{port}",
+                  "zmq_bind": False})], name="p_zmq_write"),
+        runtime=runtime)
+    writer.create_stream_local("tx", {})
+    run_until(runtime, lambda: False, timeout=0.2)   # let sockets settle
+
+    responses = queue.Queue()
+    writer.process_frame_local({"text": "over the wire"}, stream_id="tx",
+                               queue_response=responses)
+    assert run_until(runtime, lambda: len(collected) >= 1, timeout=10.0)
+    assert collected[0] == "over the wire"
+
+
+# -- tty --------------------------------------------------------------------
+
+def test_tty_read_write(tmp_path, runtime):
+    import tests_media_helpers
+    collected = []
+    tests_media_helpers.SINK = collected
+    output = io.StringIO()
+
+    pipeline = Pipeline(definition(
+        ["(Read Write)"],
+        [element("Read", "TextReadTTY", ["text"], ["text"],
+                 {"data_sources": "tty://stdin"}),
+         element("Write", "TextWriteTTY", ["text"], ["text"],
+                 {"data_targets": "tty://stdout"})], name="p_tty"),
+        runtime=runtime)
+    # inject input/output streams via stream parameters
+    pipeline.create_stream_local("t1", {
+        "Read.tty_input": io.StringIO("alpha\nbeta\n/q\n"),
+        "Write.tty_output": output})
+    assert run_until(
+        runtime, lambda: output.getvalue().count("\n") >= 2, timeout=10.0)
+    assert output.getvalue() == "alpha\nbeta\n"

@@ -1,0 +1,25 @@
+"""Collector elements for media tests; SINK is swapped per-test."""
+
+import numpy as np
+
+from aiko_services_tpu.pipeline import PipelineElement, StreamEvent
+
+SINK: list = []
+
+
+class Collect(PipelineElement):
+    def process_frame(self, stream, image=None, **inputs):
+        SINK.append(np.asarray(image))
+        return StreamEvent.OKAY, {}
+
+
+class CollectSpectrum(PipelineElement):
+    def process_frame(self, stream, spectrum=None, **inputs):
+        SINK.append(np.asarray(spectrum))
+        return StreamEvent.OKAY, {}
+
+
+class CollectText(PipelineElement):
+    def process_frame(self, stream, text=None, **inputs):
+        SINK.append(text)
+        return StreamEvent.OKAY, {}
