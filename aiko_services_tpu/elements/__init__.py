@@ -13,6 +13,7 @@ from .video import (VideoReadFile, VideoWriteFile, VideoSample,
 from .audio import (AudioReadFile, AudioWriteFile, AudioFraming,
                     AudioResampler, AudioFFT, AudioOutput, read_wav,
                     write_wav)
+from .detect import Detector
 from .observe import Inspect, Metrics
 from .expression import Expression, AllOutputs, evaluate_expression
 from .control import Loop

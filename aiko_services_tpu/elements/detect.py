@@ -1,0 +1,96 @@
+"""Detection PipelineElement (BASELINE config 2; reference equivalent:
+examples/yolo/yolo.py:50-93 YoloDetector wrapping ultralytics/torch).
+
+``Detector`` hosts the framework's JAX detector (models/detector.py) on
+its mesh: weights init (or restore from a checkpoint directory
+parameter) at first use, forward+decode+NMS jitted once per input
+resolution via the element JitCache, detections emitted as the same
+overlay dict the reference's elements feed ImageOverlay
+(yolo.py:80-92).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import detector
+from ..pipeline import StreamEvent, TPUElement
+
+__all__ = ["Detector"]
+
+_DEFAULT_CLASSES = ["person", "robot_dog", "ball", "obstacle"]
+
+
+class Detector(TPUElement):
+    """image [H, W, 3] uint8/float -> ``overlay`` rectangles +
+    ``detections`` list.
+
+    Parameters: ``num_classes``, ``class_names``, ``score_threshold``,
+    ``checkpoint`` (optional orbax directory with {"params": ...}).
+    """
+
+    def __init__(self, context):
+        super().__init__(context)
+        self._params = None
+        self._config = None
+        self._detect = None
+
+    def _ensure_model(self):
+        if self._params is not None:
+            return
+        names, _ = self.get_parameter("class_names", _DEFAULT_CLASSES)
+        threshold, _ = self.get_parameter("score_threshold", 0.25)
+        width, _ = self.get_parameter("width", 8)
+        self._class_names = list(names)
+        self._config = detector.DetectorConfig(
+            num_classes=len(self._class_names), width=int(width),
+            score_threshold=float(threshold), max_detections=32)
+        checkpoint, found = self.get_parameter("checkpoint", None)
+        if found and checkpoint:
+            from ..models.checkpoint import restore_pytree
+            template = detector.init_params(jax.random.PRNGKey(0),
+                                            self._config)
+            self._params = restore_pytree(checkpoint,
+                                          template={"params": template}
+                                          )["params"]
+        else:
+            seed, _ = self.get_parameter("seed", 0)
+            self._params = detector.init_params(
+                jax.random.PRNGKey(int(seed)), self._config)
+        self._params = self.put(self._params)
+        config = self._config
+        self._detect = self.jit(
+            lambda params, images:
+            detector.detect.__wrapped__(params, config, images))
+
+    def process_frame(self, stream, image=None, **inputs):
+        self._ensure_model()
+        array = jnp.asarray(image)
+        if array.dtype == jnp.uint8:
+            array = array.astype(jnp.float32) / 255.0
+        batched = array[None] if array.ndim == 3 else array
+        result = self._detect(self._params, batched)
+
+        boxes = np.asarray(result["boxes"][0], dtype=np.float32)
+        scores = np.asarray(result["scores"][0], dtype=np.float32)
+        classes = np.asarray(result["classes"][0])
+        valid = np.asarray(result["valid"][0])
+
+        rectangles, detections = [], []
+        for i in np.nonzero(valid)[0]:
+            x1, y1, x2, y2 = boxes[i].tolist()
+            name = self._class_names[int(classes[i])] \
+                if int(classes[i]) < len(self._class_names) else "?"
+            rectangles.append({
+                "x": max(0.0, x1), "y": max(0.0, y1),
+                "w": max(0.0, x2 - x1), "h": max(0.0, y2 - y1),
+                "name": f"{name} {scores[i]:.2f}"})
+            detections.append({"class": name,
+                               "score": float(scores[i]),
+                               "box": [x1, y1, x2, y2]})
+        return StreamEvent.OKAY, {
+            "image": image,
+            "overlay": {"rectangles": rectangles},
+            "detections": detections}

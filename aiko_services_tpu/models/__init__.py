@@ -1,3 +1,4 @@
+from . import detector
 from . import llama
 from . import long_context
 from .batching import ContinuousBatcher, Request

@@ -1,0 +1,108 @@
+"""Detector model + element (BASELINE config 2 on the CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from conftest import run_until
+from aiko_services_tpu.models import detector
+from aiko_services_tpu.pipeline import Pipeline
+
+
+def test_forward_shapes():
+    config = detector.DetectorConfig.tiny()
+    params = detector.init_params(jax.random.PRNGKey(0), config)
+    images = jnp.zeros((2, 64, 64, 3), dtype=jnp.float32)
+    predictions = detector.forward(params, config, images)
+    assert [tuple(p.shape) for p in predictions] == [
+        (2, 8, 8, 4 + config.num_classes),
+        (2, 4, 4, 4 + config.num_classes),
+        (2, 2, 2, 4 + config.num_classes)]
+
+
+def test_decode_boxes_in_bounds():
+    config = detector.DetectorConfig.tiny()
+    params = detector.init_params(jax.random.PRNGKey(0), config)
+    images = jax.random.uniform(jax.random.PRNGKey(1), (1, 64, 64, 3))
+    boxes, scores = detector.decode(
+        config, detector.forward(params, config, images), (64, 64))
+    assert boxes.shape == (1, 8 * 8 + 4 * 4 + 2 * 2, 4)
+    assert scores.shape[-1] == config.num_classes
+    # centers inside the image; box widths positive
+    assert bool((boxes[..., 2] >= boxes[..., 0]).all())
+    assert bool((boxes[..., 3] >= boxes[..., 1]).all())
+
+
+def test_nms_suppresses_overlaps():
+    config = detector.DetectorConfig.tiny(num_classes=2)
+    boxes = jnp.asarray([[0.1, 0.1, 0.5, 0.5],
+                         [0.12, 0.12, 0.52, 0.52],     # overlaps first
+                         [0.6, 0.6, 0.9, 0.9]])
+    scores = jnp.asarray([[0.9, 0.0],
+                          [0.8, 0.0],
+                          [0.0, 0.7]])
+    result = detector.nms(config, boxes, scores)
+    valid = np.asarray(result["valid"])
+    kept_boxes = np.asarray(result["boxes"])[valid]
+    assert valid.sum() == 2
+    np.testing.assert_allclose(kept_boxes[0], [0.1, 0.1, 0.5, 0.5],
+                               atol=1e-6)
+    np.testing.assert_allclose(kept_boxes[1], [0.6, 0.6, 0.9, 0.9],
+                               atol=1e-6)
+    assert np.asarray(result["classes"])[valid].tolist() == [0, 1]
+
+
+def test_nms_score_threshold():
+    config = detector.DetectorConfig.tiny(num_classes=1)
+    boxes = jnp.asarray([[0.1, 0.1, 0.2, 0.2], [0.5, 0.5, 0.6, 0.6]])
+    scores = jnp.asarray([[0.9], [0.1]])          # second below 0.25
+    result = detector.nms(config, boxes, scores)
+    assert np.asarray(result["valid"]).sum() == 1
+
+
+def test_detect_jits_end_to_end():
+    config = detector.DetectorConfig.tiny()
+    params = detector.init_params(jax.random.PRNGKey(0), config)
+    images = jax.random.uniform(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    result = detector.detect(params, config, images)
+    assert result["boxes"].shape == (2, config.max_detections, 4)
+    assert result["valid"].dtype == bool
+
+
+def test_detector_element_pipeline(tmp_path, runtime):
+    """image -> Detector -> ImageOverlay -> write, end to end."""
+    from PIL import Image
+    source = tmp_path / "in.png"
+    Image.new("RGB", (64, 64), (128, 90, 40)).save(source)
+    target = tmp_path / "out.png"
+
+    def element(name, cls, inputs, outputs, parameters=None,
+                module="aiko_services_tpu.elements"):
+        return {"name": name,
+                "input": [{"name": n} for n in inputs],
+                "output": [{"name": n} for n in outputs],
+                "deploy": {"local": {"module": module,
+                                     "class_name": cls}},
+                "parameters": parameters or {}}
+
+    pipeline = Pipeline({
+        "version": 0, "name": "p_detect", "runtime": "jax",
+        "graph": ["(Read Detect Overlay Write)"],
+        "parameters": {},
+        "elements": [
+            element("Read", "ImageReadFile", ["path"], ["image"],
+                    {"data_sources": f"file://{source}"}),
+            element("Detect", "Detector", ["image"],
+                    ["image", "overlay", "detections"],
+                    {"score_threshold": 0.0},
+                    module="aiko_services_tpu.elements.detect"),
+            element("Overlay", "ImageOverlay", ["image", "overlay"],
+                    ["image"]),
+            element("Write", "ImageWriteFile", ["image"], ["path"],
+                    {"data_targets": f"file://{target}"})]},
+        runtime=runtime)
+    pipeline.create_stream_local("s1", {})
+    assert run_until(runtime, lambda: target.exists(), timeout=30.0)
+
+    detect_element = pipeline.graph.get_node("Detect").element
+    assert detect_element.jit_cache.stats["misses"] == 1
