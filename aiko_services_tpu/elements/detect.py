@@ -40,12 +40,21 @@ class Detector(TPUElement):
     def _ensure_model(self):
         if self._params is not None:
             return
-        names, _ = self.get_parameter("class_names", _DEFAULT_CLASSES)
+        names, names_found = self.get_parameter("class_names",
+                                                _DEFAULT_CLASSES)
         threshold, _ = self.get_parameter("score_threshold", 0.25)
         width, _ = self.get_parameter("width", 8)
         self._class_names = list(names)
+        num_classes, nc_found = self.get_parameter(
+            "num_classes", len(self._class_names))
+        num_classes = int(num_classes)
+        if names_found and nc_found \
+                and num_classes != len(self._class_names):
+            raise ValueError(
+                f"num_classes={num_classes} conflicts with "
+                f"{len(self._class_names)} class_names")
         self._config = detector.DetectorConfig(
-            num_classes=len(self._class_names), width=int(width),
+            num_classes=num_classes, width=int(width),
             score_threshold=float(threshold), max_detections=32)
         checkpoint, found = self.get_parameter("checkpoint", None)
         if found and checkpoint:
@@ -83,9 +92,14 @@ class Detector(TPUElement):
             x1, y1, x2, y2 = boxes[i].tolist()
             name = self._class_names[int(classes[i])] \
                 if int(classes[i]) < len(self._class_names) else "?"
+            # Clip to [0, 1]: ImageOverlay treats any coordinate > 1 as
+            # absolute pixels, so an edge detection spilling past the
+            # image border must stay in relative range.
+            cx1, cy1 = min(max(x1, 0.0), 1.0), min(max(y1, 0.0), 1.0)
+            cx2, cy2 = min(max(x2, 0.0), 1.0), min(max(y2, 0.0), 1.0)
             rectangles.append({
-                "x": max(0.0, x1), "y": max(0.0, y1),
-                "w": max(0.0, x2 - x1), "h": max(0.0, y2 - y1),
+                "x": cx1, "y": cy1,
+                "w": max(0.0, cx2 - cx1), "h": max(0.0, cy2 - cy1),
                 "name": f"{name} {scores[i]:.2f}"})
             detections.append({"class": name,
                                "score": float(scores[i]),

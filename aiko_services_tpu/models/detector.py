@@ -82,7 +82,10 @@ def _init_conv(key, cin, cout, kernel, dtype):
 def init_params(key: jax.Array, config: DetectorConfig) -> dict:
     c = config
     dtype = _dtype(c)
-    keys = iter(jax.random.split(key, 64))
+    # stem + 4 stage downs + 2 convs per bottleneck block + 2 laterals
+    # + 3 heads
+    key_count = 10 + 8 * c.depth
+    keys = iter(jax.random.split(key, key_count))
     w = c.width
 
     def conv(cin, cout, kernel=3):
@@ -211,7 +214,16 @@ def nms(config: DetectorConfig, boxes: jax.Array, scores: jax.Array) \
     keep = jnp.logical_and(keep, top_scores > config.score_threshold)
     keep = jax.lax.fori_loop(0, k, body, keep)
 
-    # Compact the survivors to the front, pad with invalid slots.
+    # Compact the survivors to the front, pad with invalid slots.  Small
+    # inputs can have fewer than max_detections grid cells: pad the
+    # candidate pool so the slate is always exactly [m] (fixed-shape
+    # contract for cross-resolution batching).
+    if k < m:
+        pad = m - k
+        top_boxes = jnp.pad(top_boxes, ((0, pad), (0, 0)))
+        top_scores = jnp.pad(top_scores, (0, pad))
+        top_classes = jnp.pad(top_classes, (0, pad))
+        keep = jnp.pad(keep, (0, pad))
     order = jnp.argsort(~keep, stable=True)[:m]
     return {"boxes": top_boxes[order],
             "scores": top_scores[order],

@@ -1,0 +1,10 @@
+"""Orchestration layer (reference: src/aiko_services/main/process_manager.py
+and lifecycle.py): child-process management and elastic worker fleets."""
+
+from .process_manager import ProcessManager  # noqa: F401
+from .lifecycle import (  # noqa: F401
+    LifeCycleManager, LifeCycleClient,
+    PROTOCOL_LIFECYCLE_MANAGER, PROTOCOL_LIFECYCLE_CLIENT)
+
+__all__ = ["ProcessManager", "LifeCycleManager", "LifeCycleClient",
+           "PROTOCOL_LIFECYCLE_MANAGER", "PROTOCOL_LIFECYCLE_CLIENT"]

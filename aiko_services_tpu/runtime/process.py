@@ -216,6 +216,12 @@ class ProcessRuntime:
         self._registrar_handlers.append(handler)
         handler(self.registrar)
 
+    def remove_registrar_handler(self, handler: Callable):
+        try:
+            self._registrar_handlers.remove(handler)
+        except ValueError:
+            pass
+
     def set_terminate_on_registrar_lost(self, value: bool = True):
         self._terminate_registrar_lost = value
 

@@ -281,7 +281,9 @@ class ServicesCache:
                                                 self._registrar_out)
             self._registrar_out = None
         # Registrar lost OR changed: the mirror is stale either way.
-        # Purge it, notifying remove handlers, before (re)sharing.
+        # Flip out of "ready" FIRST so purge-driven remove notifications
+        # are distinguishable from genuine live removals, then purge.
+        self.state = "empty"
         if len(self.registry):
             for record in self.registry.all():
                 for add_h, remove_h, flt in list(self._handlers):
@@ -289,8 +291,7 @@ class ServicesCache:
                         remove_h(record)
             self.registry = ServiceRegistry()
         if registrar is None:
-            self.state = "empty"
-            return
+            return                 # stays "empty"
         self._registrar_out = f"{registrar['topic_path']}/out"
         self.runtime.add_message_handler(self._on_event, self._registrar_out)
         self.state = "share"

@@ -1,0 +1,130 @@
+"""Orchestration layer: ProcessManager child reaping; LifeCycleManager /
+LifeCycleClient handshake, EC state watch, deletion, and crash detection —
+all in one process over the loopback broker (SURVEY §4 philosophy)."""
+
+import sys
+
+from conftest import run_until
+
+from aiko_services_tpu.orchestration import (
+    ProcessManager, LifeCycleManager, LifeCycleClient)
+from aiko_services_tpu.services import Registrar
+
+
+def test_process_manager_spawn_and_reap(runtime):
+    exits = []
+    manager = ProcessManager(
+        engine=runtime.engine, poll_period=0.05,
+        exit_handler=lambda id, p, rc: exits.append((id, rc)))
+    manager.spawn("quick", sys.executable, ["-c", "import sys; sys.exit(3)"])
+    assert run_until(runtime, lambda: exits == [("quick", 3)], timeout=10.0)
+    assert len(manager) == 0
+    manager.terminate()
+
+
+def test_process_manager_destroy(runtime):
+    exits = []
+    manager = ProcessManager(
+        engine=runtime.engine, poll_period=0.05,
+        exit_handler=lambda id, p, rc: exits.append(id))
+    manager.spawn("sleeper", sys.executable,
+                  ["-c", "import time; time.sleep(60)"])
+    manager.destroy("sleeper")
+    assert run_until(runtime, lambda: exits == ["sleeper"], timeout=10.0)
+    manager.terminate()
+
+
+def _fleet(runtime, **kwargs):
+    """Manager whose launcher instantiates clients in-process."""
+    clients = {}
+
+    def launcher(client_id, manager_topic):
+        clients[client_id] = LifeCycleClient(
+            f"worker_{client_id}", client_id, manager_topic, runtime=runtime)
+
+    manager = LifeCycleManager(launcher=launcher, runtime=runtime, **kwargs)
+    return manager, clients
+
+
+def test_lifecycle_handshake_and_state_watch(runtime):
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    manager, clients = _fleet(runtime)
+
+    ids = [manager.create_client() for _ in range(3)]
+    assert run_until(runtime, lambda: manager.client_count() == 3,
+                     timeout=5.0)
+    assert sorted(manager.clients) == sorted(ids)
+    assert manager.share["client_count"] == 3
+
+    # The per-client ECConsumer mirrors the worker's lifecycle state.
+    assert run_until(
+        runtime,
+        lambda: all(rec.ec_cache.get("lifecycle") == "ready"
+                    for rec in manager.clients.values()),
+        timeout=5.0)
+    manager.stop()
+
+
+def test_lifecycle_destroy_client(runtime):
+    from aiko_services_tpu.services.share import services_cache_singleton
+
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    events = []
+    manager, clients = _fleet(
+        runtime, client_change_handler=lambda ev, cid: events.append((ev,
+                                                                      cid)))
+    cid = manager.create_client()
+    assert run_until(runtime, lambda: manager.client_count() == 1,
+                     timeout=5.0)
+    # Deletion detection rides the Registrar event stream: wait until the
+    # directory has actually seen the worker before destroying it.
+    cache = services_cache_singleton(runtime)
+    worker_topic = manager.clients[cid].topic_path
+    assert run_until(runtime,
+                     lambda: cache.registry.get(worker_topic) is not None,
+                     timeout=5.0)
+
+    manager.destroy_client(cid)
+    # Client honors (terminate): deregisters; registrar remove event drops
+    # it from the manager's fleet.
+    assert run_until(runtime, lambda: manager.client_count() == 0,
+                     timeout=5.0)
+    assert ("add", cid) in events and ("remove", cid) in events
+    manager.stop()
+
+
+def test_lifecycle_crash_detected_via_registrar(runtime):
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    manager, clients = _fleet(runtime)
+    cid = manager.create_client()
+    assert run_until(runtime, lambda: manager.client_count() == 1,
+                     timeout=5.0)
+    from aiko_services_tpu.services.share import services_cache_singleton
+    cache = services_cache_singleton(runtime)
+    worker_topic = clients[cid].topic_path
+    assert run_until(runtime,
+                     lambda: cache.registry.get(worker_topic) is not None,
+                     timeout=5.0)
+
+    # Simulate a crash: the worker vanishes without a handshake --
+    # deregistration reaches the manager via the registrar event stream.
+    worker = clients[cid]
+    worker.stop()
+    runtime.remove_service(worker.service_id)
+    assert run_until(runtime, lambda: manager.client_count() == 0,
+                     timeout=5.0)
+    manager.stop()
+
+
+def test_lifecycle_handshake_timeout(runtime):
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    events = []
+    manager = LifeCycleManager(
+        launcher=lambda cid, topic: None,        # never starts anything
+        handshake_lease_time=0.2, runtime=runtime,
+        client_change_handler=lambda ev, cid: events.append(ev))
+    manager.create_client()
+    assert run_until(runtime, lambda: "handshake_timeout" in events,
+                     timeout=5.0)
+    assert manager.client_count() == 0
+    manager.stop()

@@ -1,0 +1,50 @@
+"""Registrar outage must not eject healthy workers from a LifeCycleManager
+fleet: the ServicesCache purge is not a death signal.  After the directory
+returns, reconciliation prunes only workers that really disappeared."""
+
+from conftest import run_until
+
+from aiko_services_tpu.orchestration import LifeCycleManager, LifeCycleClient
+from aiko_services_tpu.services import Registrar
+from aiko_services_tpu.services.share import services_cache_singleton
+from aiko_services_tpu.transport import get_broker
+
+
+def test_fleet_survives_registrar_bounce(runtime):
+    registrar = Registrar(runtime=runtime, primary_search_timeout=0.05)
+    clients = {}
+
+    def launcher(cid, topic):
+        clients[cid] = LifeCycleClient(f"w{cid}", cid, topic,
+                                       runtime=runtime)
+
+    removed = []
+    manager = LifeCycleManager(
+        launcher=launcher, runtime=runtime,
+        client_change_handler=lambda ev, cid: removed.append((ev, cid)))
+    manager.create_clients(2)
+    assert run_until(runtime, lambda: manager.client_count() == 2,
+                     timeout=5.0)
+    cache = services_cache_singleton(runtime)
+    assert run_until(
+        runtime,
+        lambda: all(cache.registry.get(c.topic_path) for c in
+                    clients.values()),
+        timeout=5.0)
+
+    # Bounce: someone clobbers the retained election topic with "absent".
+    # Every process sees the registrar vanish (cache purges); the primary
+    # then re-asserts its retained "found" record and the directory
+    # repopulates.
+    get_broker().publish(runtime.topic_registrar_boot, "(primary absent)",
+                         retain=True)
+    assert run_until(runtime,
+                     lambda: registrar.state == "primary"
+                     and cache.state == "ready"
+                     and runtime.registrar is not None,
+                     timeout=5.0)
+    # Fleet intact: no spurious removals, both workers still tracked.
+    runtime.run(timeout=1.0)          # let reconciliation run
+    assert manager.client_count() == 2
+    assert not any(ev == "remove" for ev, _ in removed)
+    manager.stop()
