@@ -1,0 +1,200 @@
+"""Command-line tools (reference: ``aiko_pipeline`` / ``aiko_registrar`` /
+``aiko_dashboard`` console scripts, src/aiko_services/main/pipeline.py:
+1826-2034, registrar.py:358, dashboard.py:771-790).
+
+No pip entry points are assumed; everything runs via::
+
+    python -m aiko_services_tpu registrar
+    python -m aiko_services_tpu pipeline create def.json -fd "(x: 1)"
+    python -m aiko_services_tpu pipeline list
+    python -m aiko_services_tpu pipeline destroy NAME
+    python -m aiko_services_tpu recorder | storage | dashboard
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import click
+
+from .runtime import init_process
+from .utils import get_logger
+
+_logger = get_logger("aiko.cli")
+
+
+def _runtime(transport: str | None):
+    runtime = init_process(transport=transport)
+    runtime.initialize()
+    return runtime
+
+
+_transport_option = click.option(
+    "--transport", "-t", default=None,
+    help="message fabric: mqtt | loopback (default: $AIKO_TRANSPORT)")
+
+
+@click.group()
+def main():
+    """aiko_services_tpu command line."""
+
+
+# -- registrar --------------------------------------------------------------
+
+@main.command()
+@_transport_option
+def registrar(transport):
+    """Run a Registrar (discovery directory + primary election)."""
+    from .services import Registrar
+
+    runtime = _runtime(transport)
+    Registrar(runtime=runtime)
+    runtime.run()
+
+
+# -- recorder / storage -----------------------------------------------------
+
+@main.command()
+@_transport_option
+def recorder(transport):
+    """Run a Recorder (namespace-wide log aggregation)."""
+    from .services import Recorder
+
+    runtime = _runtime(transport)
+    Recorder(runtime=runtime)
+    runtime.run()
+
+
+@main.command()
+@_transport_option
+@click.option("--database", "-d", default="aiko_storage.db",
+              help="sqlite database path")
+def storage(transport, database):
+    """Run a Storage actor (persistent key/value)."""
+    from .services import Storage
+
+    runtime = _runtime(transport)
+    Storage(database_path=database, runtime=runtime)
+    runtime.run()
+
+
+# -- pipeline ---------------------------------------------------------------
+
+@main.group()
+def pipeline():
+    """Create / list / destroy dataflow pipelines."""
+
+
+@pipeline.command("create")
+@click.argument("definition_pathname")
+@_transport_option
+@click.option("--name", "-n", default=None, help="override pipeline name")
+@click.option("--stream-id", "-s", default=None,
+              help="create a stream with this id at startup")
+@click.option("--frame-data", "-fd", default=None,
+              help="frame data for the startup stream, e.g. '(x: 1)'")
+@click.option("--parameter", "-p", "parameters", nargs=2, multiple=True,
+              help="stream parameter NAME VALUE (repeatable)")
+@click.option("--frame-rate", "-fr", default=0.0,
+              help="frame generator rate limit (frames/sec, 0 = max)")
+def pipeline_create(definition_pathname, transport, name, stream_id,
+                    frame_data, parameters, frame_rate):
+    """Create a Pipeline from DEFINITION_PATHNAME (JSON) and run it."""
+    from .pipeline import create_pipeline
+    from .utils import parse_value
+
+    runtime = _runtime(transport)
+    instance = create_pipeline(definition_pathname, name=name,
+                               runtime=runtime)
+    if stream_id is not None or frame_data is not None:
+        stream_parameters = {key: value for key, value in parameters}
+        if frame_rate:
+            stream_parameters["rate"] = frame_rate
+        instance.create_stream_local(stream_id or "1", stream_parameters)
+        if frame_data:
+            data = parse_value(frame_data)
+            if not isinstance(data, dict):
+                raise click.BadParameter(
+                    "frame data must be an S-expression dictionary, "
+                    "e.g. '(x: 1)'")
+            instance.create_frame_local(
+                instance.streams[stream_id or "1"], data)
+    runtime.run()
+
+
+@pipeline.command("list")
+@_transport_option
+@click.option("--timeout", default=3.0, help="discovery wait seconds")
+def pipeline_list(transport, timeout):
+    """List pipelines registered in the namespace directory."""
+    from .pipeline import PROTOCOL_PIPELINE
+    from .services import ServiceFilter
+    from .services.share import services_cache_singleton
+
+    runtime = _runtime(transport)
+    cache = services_cache_singleton(runtime)
+    runtime.run(until=lambda: cache.state == "ready", timeout=timeout)
+    records = cache.registry.query(
+        ServiceFilter(protocol=PROTOCOL_PIPELINE))
+    if cache.state != "ready":
+        click.echo("warning: no registrar found", err=True)
+    for record in records:
+        click.echo(f"{record.topic_path}  {record.name}  "
+                   f"tags={','.join(record.tags)}")
+    click.echo(f"{len(records)} pipeline(s)")
+
+
+@pipeline.command("destroy")
+@click.argument("name")
+@_transport_option
+@click.option("--timeout", default=3.0, help="discovery wait seconds")
+def pipeline_destroy(name, transport, timeout):
+    """Ask the named pipeline process to stop."""
+    from .pipeline import PROTOCOL_PIPELINE
+    from .services import ServiceFilter, do_command
+
+    runtime = _runtime(transport)
+    done = []
+
+    def send_stop(proxy):
+        proxy.stop()
+        done.append(proxy.topic_path)
+
+    do_command(runtime, None,
+               ServiceFilter(name=name, protocol=PROTOCOL_PIPELINE),
+               send_stop)
+    runtime.run(until=lambda: bool(done), timeout=timeout)
+    if done:
+        click.echo(f"stop sent to {done[0]}")
+    else:
+        click.echo(f"pipeline {name!r} not found", err=True)
+        sys.exit(1)
+
+
+@pipeline.command("validate")
+@click.argument("definition_pathname")
+def pipeline_validate(definition_pathname):
+    """Parse + schema-check a pipeline definition without running it."""
+    from .pipeline import load_pipeline_definition
+
+    definition = load_pipeline_definition(definition_pathname)
+    click.echo(json.dumps(
+        {"name": definition.name,
+         "graph": definition.graph,
+         "elements": definition.element_names()}, indent=2))
+
+
+# -- dashboard --------------------------------------------------------------
+
+@main.command()
+@_transport_option
+def dashboard(transport):
+    """Terminal dashboard: browse services, watch share dicts, tail logs."""
+    from .dashboard import run_dashboard
+
+    run_dashboard(transport)
+
+
+if __name__ == "__main__":
+    main()

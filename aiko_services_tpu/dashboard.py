@@ -1,0 +1,214 @@
+"""Terminal dashboard (reference: src/aiko_services/main/dashboard.py:
+317-790, an asciimatics TUI; this one is stdlib-curses with the same
+capability set):
+
+- live service table from the :class:`ServicesCache` directory mirror;
+- selecting a service attaches an :class:`ECConsumer` to live-view its
+  ``share`` dict (the observability surface: lifecycle, log_level,
+  streams, element_count, ...);
+- tails the selected service's ``log`` topic;
+- publishes ``(update name value)`` to ``topic/control`` to change a
+  share variable remotely (reference dashboard.py:552-700);
+- ``(stop)`` to ask a service to shut down.
+
+``DashboardModel`` is UI-free and fully testable offline; ``run_dashboard``
+is the curses front end polling at ~5 Hz (reference refresh rate,
+dashboard.py:152).
+"""
+
+from __future__ import annotations
+
+import collections
+
+from .runtime import init_process
+from .services import ECConsumer
+from .services.share import services_cache_singleton
+from .utils import generate, get_logger
+
+__all__ = ["DashboardModel", "run_dashboard"]
+
+_logger = get_logger("aiko.dashboard")
+
+LOG_RING_SIZE = 256
+
+
+class DashboardModel:
+    """Directory + selected-service state behind any dashboard UI."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self.cache = services_cache_singleton(runtime)
+        self.selected: str | None = None          # topic_path
+        self.share_view: dict = {}
+        self.log_lines: collections.deque = collections.deque(
+            maxlen=LOG_RING_SIZE)
+        self._consumer: ECConsumer | None = None
+        self._log_topic: str | None = None
+
+    # -- directory ---------------------------------------------------------
+
+    def services(self) -> list:
+        """ServiceRecords sorted by topic path (stable table order)."""
+        return sorted(self.cache.registry.all(),
+                      key=lambda record: record.topic_path)
+
+    # -- selection ---------------------------------------------------------
+
+    def select(self, topic_path: str):
+        if topic_path == self.selected:
+            return
+        self.deselect()
+        self.selected = topic_path
+        self.share_view = {}
+        self._consumer = ECConsumer(self.runtime, topic_path,
+                                    self.share_view)
+        self._log_topic = f"{topic_path}/log"
+        self.runtime.add_message_handler(self._on_log, self._log_topic)
+
+    def deselect(self):
+        if self._consumer is not None:
+            self._consumer.terminate()
+            self._consumer = None
+        if self._log_topic is not None:
+            self.runtime.remove_message_handler(self._on_log,
+                                                self._log_topic)
+            self._log_topic = None
+        self.selected = None
+        self.share_view = {}
+        self.log_lines.clear()
+
+    def _on_log(self, topic: str, payload):
+        self.log_lines.append(str(payload))
+
+    # -- remote actions ----------------------------------------------------
+
+    def update_share(self, name: str, value):
+        """Publish ``(update name value)`` to the selected service's
+        control topic -- live remote reconfiguration."""
+        if self.selected is None:
+            return
+        self.runtime.message.publish(f"{self.selected}/control",
+                                     generate("update", [name, value]))
+
+    def stop_selected(self):
+        if self.selected is None:
+            return
+        self.runtime.message.publish(f"{self.selected}/in",
+                                     generate("stop", []))
+
+    def share_items(self) -> list[tuple[str, str]]:
+        def flatten(data, prefix=""):
+            for key in sorted(data):
+                value = data[key]
+                if isinstance(value, dict):
+                    yield from flatten(value, f"{prefix}{key}.")
+                else:
+                    yield f"{prefix}{key}", str(value)
+        return list(flatten(self.share_view))
+
+    def terminate(self):
+        self.deselect()
+
+
+# ---------------------------------------------------------------------------
+# curses front end
+
+
+def run_dashboard(transport: str | None = None):      # pragma: no cover
+    import curses
+
+    runtime = init_process(transport=transport)
+    runtime.initialize()
+    model = DashboardModel(runtime)
+
+    # The event engine must keep running while curses owns the main
+    # thread: drive it from a daemon thread and marshal all framework
+    # calls through engine.post for single-threaded semantics.
+    import threading
+    thread = threading.Thread(target=runtime.run, daemon=True,
+                              name="aiko.dashboard.engine")
+    thread.start()
+
+    curses.wrapper(_dashboard_loop, runtime, model)
+    runtime.terminate()
+
+
+def _dashboard_loop(stdscr, runtime, model):          # pragma: no cover
+    import curses
+
+    curses.curs_set(0)
+    stdscr.nodelay(True)
+    stdscr.timeout(200)           # ~5 Hz refresh
+    cursor = 0
+    show_log = False
+    status = "q quit | enter select | l logs | u update | k stop service"
+
+    while True:
+        records = model.services()
+        cursor = max(0, min(cursor, len(records) - 1))
+        height, width = stdscr.getmaxyx()
+        stdscr.erase()
+        title = (f" aiko_services_tpu dashboard -- {runtime.namespace} "
+                 f"-- {len(records)} services ")
+        stdscr.addnstr(0, 0, title.ljust(width), width - 1,
+                       curses.A_REVERSE)
+
+        table_height = max(3, (height - 4) // 2)
+        for row, record in enumerate(records[:table_height]):
+            marker = ">" if row == cursor else " "
+            chosen = "*" if record.topic_path == model.selected else " "
+            line = (f"{marker}{chosen} {record.name:20.20s} "
+                    f"{record.protocol:32.32s} {record.topic_path}")
+            attr = curses.A_BOLD if row == cursor else curses.A_NORMAL
+            stdscr.addnstr(1 + row, 0, line, width - 1, attr)
+
+        divider = 1 + table_height
+        stdscr.hline(divider, 0, "-", width)
+        body_top = divider + 1
+        body_rows = height - body_top - 1
+        if show_log and model.selected:
+            lines = list(model.log_lines)[-body_rows:]
+            for i, line in enumerate(lines):
+                stdscr.addnstr(body_top + i, 0, line, width - 1)
+        elif model.selected:
+            items = model.share_items()[:body_rows]
+            for i, (name, value) in enumerate(items):
+                stdscr.addnstr(body_top + i, 0,
+                               f"{name:32.32s} {value}", width - 1)
+        stdscr.addnstr(height - 1, 0, status.ljust(width - 1), width - 1,
+                       curses.A_REVERSE)
+        stdscr.refresh()
+
+        key = stdscr.getch()
+        if key in (ord("q"), ord("Q")):
+            break
+        if key == curses.KEY_UP:
+            cursor -= 1
+        elif key == curses.KEY_DOWN:
+            cursor += 1
+        elif key in (curses.KEY_ENTER, 10, 13) and records:
+            runtime.engine.post(model.select,
+                                records[cursor].topic_path)
+        elif key in (ord("l"), ord("L")):
+            show_log = not show_log
+        elif key in (ord("u"), ord("U")) and model.selected:
+            name_value = _prompt(stdscr, "update <name> <value>: ")
+            parts = name_value.split(None, 1)
+            if len(parts) == 2:
+                runtime.engine.post(model.update_share, parts[0], parts[1])
+        elif key in (ord("k"), ord("K")) and model.selected:
+            runtime.engine.post(model.stop_selected)
+
+
+def _prompt(stdscr, label):                           # pragma: no cover
+    import curses
+
+    height, width = stdscr.getmaxyx()
+    stdscr.addnstr(height - 1, 0, label.ljust(width - 1), width - 1)
+    curses.echo()
+    stdscr.nodelay(False)
+    try:
+        return stdscr.getstr(height - 1, len(label), 128).decode()
+    finally:
+        curses.noecho()
+        stdscr.nodelay(True)

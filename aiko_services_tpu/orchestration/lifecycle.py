@@ -88,10 +88,13 @@ class LifeCycleManager(Actor):
         self._client_ids = itertools.count(1)
         self.share["client_count"] = 0
         self._stopped = False
+        self._reconcile_pending = False
         self._cache = services_cache_singleton(self.runtime)
-        self._cache.add_handlers(
-            None, self._on_service_removed,
-            ServiceFilter(protocol=PROTOCOL_LIFECYCLE_CLIENT))
+        # Unfiltered: workers may subclass LifeCycleClient with their own
+        # protocol, so removal matching is by tracked topic path, not
+        # protocol.
+        self._cache.add_handlers(None, self._on_service_removed,
+                                 ServiceFilter())
         self.runtime.add_registrar_handler(self._on_registrar_change)
 
     # -- fleet API ---------------------------------------------------------
@@ -190,7 +193,7 @@ class LifeCycleManager(Actor):
         if self._cache.state != "ready":
             # Mid-(re)load removal: can't tell purge from death now --
             # reconcile against the directory once it settles.
-            self.runtime.engine.add_oneshot_timer(self._reconcile, 0.2)
+            self._schedule_reconcile(0.2)
             return
         for client_id, client in list(self.clients.items()):
             if client.topic_path == record.topic_path:
@@ -198,16 +201,24 @@ class LifeCycleManager(Actor):
 
     def _on_registrar_change(self, registrar):
         if registrar is not None and self.clients:
-            self.runtime.engine.add_oneshot_timer(self._reconcile, 0.5)
+            self._schedule_reconcile(0.5)
+
+    def _schedule_reconcile(self, delay: float):
+        """Debounced: an N-client purge arms ONE timer chain, not N."""
+        if self._reconcile_pending or self._stopped:
+            return
+        self._reconcile_pending = True
+        self.runtime.engine.add_oneshot_timer(self._reconcile, delay)
 
     def _reconcile(self):
         """After a registrar (re)election: wait for the directory mirror,
         then drop fleet members that did not re-register (died during the
         outage)."""
+        self._reconcile_pending = False
         if self._stopped:
             return
         if self._cache.state != "ready":
-            self.runtime.engine.add_oneshot_timer(self._reconcile, 0.2)
+            self._schedule_reconcile(0.2)
             return
         for client_id, record in list(self.clients.items()):
             if self._cache.registry.get(record.topic_path) is None:
@@ -220,6 +231,16 @@ class LifeCycleManager(Actor):
             _logger.info("client %s process exited rc=%s",
                          client_id, return_code)
             self._drop_client(client_id)
+            return
+        lease = self._pending.pop(client_id, None)
+        if lease is not None:
+            # Child died before handshaking (bad argv, import error...):
+            # report now instead of waiting out the handshake lease.
+            lease.terminate()
+            _logger.warning("client %s exited rc=%s before handshake",
+                            client_id, return_code)
+            if self.client_change_handler:
+                self.client_change_handler("launch_failed", client_id)
 
     def _drop_client(self, client_id):
         record = self.clients.pop(client_id, None)

@@ -112,6 +112,13 @@ class ProcessManager:
                 process.wait(remaining)
             except subprocess.TimeoutExpired:
                 process.kill()
+                # SIGKILL is asynchronous: reap, or the entry (and its
+                # exit handler) would be stranded once polling stops.
+                try:
+                    process.wait(1.0)
+                except subprocess.TimeoutExpired:
+                    _logger.error("process %s did not die after SIGKILL",
+                                  id)
         self.poll()
 
     # -- polling -----------------------------------------------------------

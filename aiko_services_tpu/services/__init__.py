@@ -7,3 +7,5 @@ from .share import (ECProducer, ECConsumer, ServicesCache,
 from .registrar import Registrar, REGISTRAR_PROTOCOL
 from .discovery import (RemoteProxy, ServiceDiscovery, get_service_proxy,
                         do_discovery, do_command, do_request)
+from .recorder import Recorder, PROTOCOL_RECORDER
+from .storage import Storage, PROTOCOL_STORAGE

@@ -1,0 +1,95 @@
+"""DashboardModel (UI-free dashboard core) and CLI commands, offline."""
+
+import json
+
+from click.testing import CliRunner
+from conftest import run_until
+
+from aiko_services_tpu.dashboard import DashboardModel
+from aiko_services_tpu.services import Actor, Registrar
+
+
+class Worker(Actor):
+    def __init__(self, name, runtime=None):
+        super().__init__(name, "test/worker:0", runtime=runtime)
+        self.share["temperature"] = 20
+
+    def warm_up(self):
+        self.ec_producer.update("temperature", 99)
+
+
+def test_dashboard_model_directory_and_share(runtime):
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    worker = Worker("worker_a", runtime=runtime)
+    model = DashboardModel(runtime)
+
+    assert run_until(
+        runtime,
+        lambda: any(r.name == "worker_a" for r in model.services()),
+        timeout=5.0)
+
+    model.select(worker.topic_path)
+    assert run_until(runtime,
+                     lambda: model.share_view.get("temperature") == "20",
+                     timeout=5.0)
+    items = dict(model.share_items())
+    assert items["lifecycle"] == "ready"
+
+    # Live share mutation propagates to the dashboard view.
+    worker.warm_up()
+    assert run_until(runtime,
+                     lambda: model.share_view.get("temperature") == "99",
+                     timeout=5.0)
+
+    # Remote update through the dashboard changes the worker itself.
+    model.update_share("log_level", "DEBUG")
+    assert run_until(runtime,
+                     lambda: worker.share["log_level"] == "DEBUG",
+                     timeout=5.0)
+
+    # Log tail.
+    worker.logger.info("dashboard sees this")
+    assert run_until(
+        runtime,
+        lambda: any("dashboard sees this" in line
+                    for line in model.log_lines),
+        timeout=5.0)
+
+    model.terminate()
+    assert model.selected is None and not model.share_view
+
+
+def _definition(tmp_path):
+    definition = {
+        "version": 0, "name": "cli_pipe", "runtime": "jax",
+        "graph": ["(echo)"],
+        "elements": [{
+            "name": "echo",
+            "input": [{"name": "text"}],
+            "output": [{"name": "text"}],
+            "deploy": {"local": {
+                "module": "aiko_services_tpu.elements.common",
+                "class_name": "Identity"}}}]}
+    path = tmp_path / "pipe.json"
+    path.write_text(json.dumps(definition))
+    return str(path)
+
+
+def test_cli_pipeline_validate(tmp_path):
+    from aiko_services_tpu.cli import main
+
+    result = CliRunner().invoke(
+        main, ["pipeline", "validate", _definition(tmp_path)])
+    assert result.exit_code == 0, result.output
+    data = json.loads(result.output)
+    assert data["name"] == "cli_pipe"
+    assert data["elements"] == ["echo"]
+
+
+def test_cli_pipeline_validate_rejects_bad(tmp_path):
+    from aiko_services_tpu.cli import main
+
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"version": 0, "name": "x"}))
+    result = CliRunner().invoke(main, ["pipeline", "validate", str(path)])
+    assert result.exit_code != 0

@@ -1,0 +1,87 @@
+"""Recorder (namespace log aggregation) and Storage (sqlite actor) over
+the loopback fabric."""
+
+from conftest import run_until
+
+from aiko_services_tpu.services import (
+    Actor, Recorder, Registrar, ServiceFilter, Storage, do_request,
+    get_service_proxy)
+
+
+class Chatty(Actor):
+    def __init__(self, name, runtime=None):
+        super().__init__(name, "test/chatty:0", runtime=runtime)
+
+    def say(self, text):
+        self.logger.info(text)
+
+
+def test_recorder_aggregates_logs(runtime):
+    recorder = Recorder(runtime=runtime)
+    chatty = Chatty("chatty", runtime=runtime)
+    for i in range(5):
+        chatty.say(f"line {i}")
+    assert run_until(runtime,
+                     lambda: chatty.topic_path in recorder.sources(),
+                     timeout=5.0)
+    tail = recorder.tail(chatty.topic_path)
+    assert len(tail) == 5
+    assert "line 4" in tail[-1]
+    recorder.stop()
+
+
+def test_recorder_replay_request(runtime):
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    recorder = Recorder(runtime=runtime)
+    chatty = Chatty("chatty2", runtime=runtime)
+    chatty.say("hello recorder")
+    run_until(runtime, lambda: chatty.topic_path in recorder.sources())
+
+    results = []
+    do_request(runtime, None, ServiceFilter(protocol="recorder"),
+               lambda proxy, topic: proxy.replay(topic, chatty.topic_path,
+                                                 8),
+               lambda items: results.append(items))
+    assert run_until(runtime, lambda: bool(results), timeout=5.0)
+    lines = [parameters[0] for command, parameters in results[0]
+             if command == "line"]
+    assert any("hello recorder" in line for line in lines)
+    recorder.stop()
+
+
+def test_storage_roundtrip(runtime, tmp_path):
+    storage = Storage(database_path=str(tmp_path / "kv.db"),
+                      runtime=runtime)
+    proxy = get_service_proxy(runtime, storage.topic_path)
+    proxy.store("alpha", 42)
+    proxy.store("beta", ["x", "y"])
+    assert run_until(runtime, lambda: storage.share["item_count"] == 2,
+                     timeout=5.0)
+    # The S-expression wire is stringly typed (reference semantics):
+    # atoms round-trip as text, structure is preserved.
+    assert storage.get_local("alpha") == "42"
+    assert storage.get_local("beta") == ["x", "y"]
+
+    # fetch over the wire
+    responses = []
+    response_topic = f"{runtime.topic_path_process}/test_fetch"
+    runtime.add_message_handler(
+        lambda t, p: responses.append(p), response_topic)
+    proxy.fetch(response_topic, "alpha")
+    assert run_until(runtime,
+                     lambda: any("item" in r and "42" in r
+                                 for r in responses),
+                     timeout=5.0)
+
+    proxy.erase("alpha")
+    assert run_until(runtime, lambda: storage.share["item_count"] == 1,
+                     timeout=5.0)
+    assert storage.get_local("alpha") is None
+
+    # persistence across instances
+    storage.stop()
+    reopened = Storage(name="storage2",
+                       database_path=str(tmp_path / "kv.db"),
+                       runtime=runtime)
+    assert reopened.get_local("beta") == ["x", "y"]
+    reopened.stop()
