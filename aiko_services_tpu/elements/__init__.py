@@ -14,6 +14,7 @@ from .audio import (AudioReadFile, AudioWriteFile, AudioFraming,
                     AudioResampler, AudioFFT, AudioOutput, read_wav,
                     write_wav)
 from .detect import Detector
+from .llm import LLM, LLMService, PROTOCOL_LLM
 from .observe import Inspect, Metrics
 from .expression import Expression, AllOutputs, evaluate_expression
 from .control import Loop

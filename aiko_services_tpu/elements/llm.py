@@ -1,0 +1,214 @@
+"""LLM serving: actor + pipeline element (BASELINE config 3; reference
+equivalent: examples/llm/elements.py:92-212, which forwards chat turns to
+an external Ollama/CUDA server via LangChain).
+
+Here serving is native to the framework:
+
+- :class:`LLMService` is an Actor owning a :class:`ContinuousBatcher`
+  (models/batching.py): weights and the batched KV cache live in HBM;
+  any number of remote callers stream generations concurrently.  Wire
+  protocol on ``topic/in``::
+
+      (generate response_topic request_id prompt max_new_tokens temp)
+
+  replies on ``response_topic``::
+
+      (token request_id fragment)     per decode step
+      (complete request_id full_text)
+
+  The decode loop rides the event engine: while work is pending the
+  service re-posts its pump, so decode ticks interleave with message
+  handling instead of blocking the process (the "batching mailbox
+  between the actor layer and the device loop" flagged in SURVEY §7).
+
+- :class:`LLM` is a PipelineElement producing ``text`` out of ``text``
+  frames, hosting its own model in-process.  To share one model (one
+  set of HBM weights) across many pipelines, wrap this element in a
+  small pipeline and reference it from the others as a remote stage
+  (``deploy: remote``) -- the framework's pause/resume continuation
+  carries the frame across, exactly like any other remote element.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..models import llama
+from ..models.batching import ContinuousBatcher, Request
+from ..models.tokenizer import ByteTokenizer, load_tokenizer
+from ..pipeline import PipelineElement, StreamEvent
+from ..services import Actor
+from ..utils import generate, get_logger, parse_number
+
+__all__ = ["LLMService", "LLM", "PROTOCOL_LLM"]
+
+_logger = get_logger("aiko.llm")
+
+PROTOCOL_LLM = "llm:0"
+
+
+def _restore(params, checkpoint: str | None):
+    if checkpoint:
+        from ..models.checkpoint import restore_pytree
+        params = restore_pytree(checkpoint,
+                                template={"params": params})["params"]
+    return params
+
+
+def _collector(tokenizer, collected: list):
+    """Emit callback appending non-EOS tokens to ``collected``."""
+    eos = set(tokenizer.eos_tokens)
+
+    def emit(request_id, token, finished):
+        if token not in eos:
+            collected.append(token)
+    return emit
+
+
+class LLMService(Actor):
+    """Continuous-batching generation server."""
+
+    def __init__(self, name: str = "llm", runtime=None,
+                 config: llama.LlamaConfig | None = None,
+                 params=None, tokenizer=None, max_slots: int = 8,
+                 checkpoint: str | None = None, seed: int = 0):
+        super().__init__(name, PROTOCOL_LLM, tags=["ec=true"],
+                         runtime=runtime)
+        if config is None:
+            config = llama.LlamaConfig.tiny()
+        if params is None:
+            params = _restore(
+                llama.init_params(jax.random.PRNGKey(seed), config),
+                checkpoint)
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.batcher = ContinuousBatcher(params, config,
+                                         max_slots=max_slots)
+        self._texts: dict[str, list[int]] = {}     # request_id -> tokens
+        self._reply_topics: dict[str, str] = {}
+        self._pumping = False
+        self.share.update({"model_layers": config.n_layers,
+                           "max_slots": max_slots,
+                           "active": 0, "queued": 0,
+                           "tokens_emitted": 0})
+
+    # -- wire API ----------------------------------------------------------
+
+    def generate(self, response_topic, request_id, prompt,
+                 max_new_tokens="128", temperature="0"):
+        """(generate response_topic request_id prompt max tokens temp)"""
+        request_id = str(request_id)
+        self._texts[request_id] = []
+        self._reply_topics[request_id] = str(response_topic)
+        self.batcher.submit(Request(
+            request_id=request_id,
+            prompt_tokens=self.tokenizer.encode(str(prompt)),
+            max_new_tokens=int(parse_number(max_new_tokens, 128)),
+            temperature=float(parse_number(temperature, 0.0)),
+            eos_tokens=self.tokenizer.eos_tokens,
+            emit=self._on_token))
+        self._start_pump()
+
+    # -- decode pump -------------------------------------------------------
+
+    def _start_pump(self):
+        if not self._pumping:
+            self._pumping = True
+            self.runtime.engine.post(self._pump)
+
+    def _pump(self):
+        active = self.batcher.step()
+        self.ec_producer.update("active", self.batcher.active_count)
+        self.ec_producer.update("queued", self.batcher.queue_depth)
+        self.ec_producer.update("tokens_emitted",
+                                self.batcher.tokens_emitted)
+        if active or self.batcher.queue_depth:
+            self.runtime.engine.post(self._pump)    # interleave, not block
+        else:
+            self._pumping = False
+
+    def _on_token(self, request_id: str, token: int, finished: bool):
+        tokens = self._texts.setdefault(request_id, [])
+        reply_topic = self._reply_topics.get(request_id)
+        if token not in self.tokenizer.eos_tokens:
+            tokens.append(token)
+            if reply_topic:
+                fragment = self.tokenizer.decode([token])
+                self.runtime.message.publish(
+                    reply_topic,
+                    generate("token", [request_id, fragment]))
+        if finished and reply_topic:
+            text = self.tokenizer.decode(tokens)
+            self.runtime.message.publish(
+                reply_topic, generate("complete", [request_id, text]))
+            self._texts.pop(request_id, None)
+            self._reply_topics.pop(request_id, None)
+
+    # -- local API ---------------------------------------------------------
+
+    def generate_local(self, prompt: str, max_new_tokens: int = 128,
+                       temperature: float = 0.0) -> str:
+        """Synchronous generation (drains the batcher inline): for
+        single-process callers and tests."""
+        collected: list[int] = []
+        self.batcher.submit(Request(
+            request_id="local",
+            prompt_tokens=self.tokenizer.encode(prompt),
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            eos_tokens=self.tokenizer.eos_tokens,
+            emit=_collector(self.tokenizer, collected)))
+        self.batcher.run_until_drained()
+        return self.tokenizer.decode(collected)
+
+
+class LLM(PipelineElement):
+    """``text`` -> generated ``text``.
+
+    Parameters: ``max_new_tokens``, ``temperature``, ``system_prompt``,
+    ``tokenizer`` (HF directory), ``checkpoint`` (orbax dir),
+    ``vocab_size``/``max_seq``/``seed`` (local tiny config).
+
+    Generation runs inline on the event loop (the reference's LLM
+    element equally blocks on its Ollama HTTP call); deploy this element
+    in its own pipeline behind a remote stage when other traffic must
+    not wait.
+    """
+
+    def __init__(self, context):
+        super().__init__(context)
+        self._batcher: ContinuousBatcher | None = None
+        self._tokenizer = None
+
+    def _ensure_model(self):
+        if self._batcher is not None:
+            return
+        checkpoint, _ = self.get_parameter("checkpoint", None)
+        tokenizer_path, found = self.get_parameter("tokenizer", None)
+        self._tokenizer = load_tokenizer(tokenizer_path) \
+            if found and tokenizer_path else ByteTokenizer()
+        vocab, _ = self.get_parameter("vocab_size",
+                                      self._tokenizer.vocab_size)
+        max_seq, _ = self.get_parameter("max_seq", 256)
+        seed, _ = self.get_parameter("seed", 0)
+        config = llama.LlamaConfig.tiny(vocab_size=int(vocab),
+                                        max_seq=int(max_seq))
+        params = _restore(
+            llama.init_params(jax.random.PRNGKey(int(seed)), config),
+            checkpoint)
+        self._batcher = ContinuousBatcher(params, config)
+
+    def process_frame(self, stream, text=None, **inputs):
+        self._ensure_model()
+        max_new, _ = self.get_parameter("max_new_tokens", 32)
+        temperature, _ = self.get_parameter("temperature", 0.0)
+        system_prompt, _ = self.get_parameter("system_prompt", "")
+        prompt = f"{system_prompt}{text}" if system_prompt else str(text)
+
+        collected: list[int] = []
+        self._batcher.submit(Request(
+            request_id=f"frame_{stream.stream_id}",
+            prompt_tokens=self._tokenizer.encode(prompt),
+            max_new_tokens=int(max_new), temperature=float(temperature),
+            eos_tokens=self._tokenizer.eos_tokens,
+            emit=_collector(self._tokenizer, collected)))
+        self._batcher.run_until_drained()
+        return StreamEvent.OKAY, {"text": self._tokenizer.decode(collected)}

@@ -318,10 +318,14 @@ class Pipeline(Actor):
                 if isinstance(element, RemoteStage):
                     if self._forward_frame(stream, frame, node):
                         return            # frame parked at remote stage
-                    # remote unavailable: retry the whole frame shortly
-                    stream.frames.pop(frame.frame_id, None)
-                    self.post_self("retry_frame",
-                                   [stream.stream_id, frame], delay=0.25)
+                    # Remote undiscovered yet: retry shortly FROM THIS
+                    # NODE -- elements before it already ran and must not
+                    # run again (their effects are in the swag).  The
+                    # frame STAYS in stream.frames so graceful
+                    # destroy_stream counts it as in-flight.
+                    self.post_self("retry_frame_at",
+                                   [stream.stream_id, frame, node.name],
+                                   delay=0.25)
                     return
                 inputs, missing = self._map_in(node, swag)
                 if missing:
@@ -393,6 +397,22 @@ class Pipeline(Actor):
             return
         stream.frames[frame.frame_id] = frame
         self._process_frame_common(stream, frame)
+
+    def retry_frame_at(self, stream_id, frame: Frame, node_name: str):
+        """Resume a frame at ``node_name`` (used when a remote stage was
+        not yet discovered): earlier elements are not re-executed."""
+        stream = self.streams.get(str(stream_id))
+        if stream is None:
+            return
+        stream.frames[frame.frame_id] = frame
+        path = self._stream_path(stream)
+        for index, node in enumerate(path):
+            if node.name == node_name:
+                self._process_frame_common(stream, frame,
+                                           nodes=path[index:])
+                return
+        self._frame_error(stream, frame,
+                          f"retry_frame_at: unknown node {node_name}")
 
     # -- name mapping ------------------------------------------------------
 

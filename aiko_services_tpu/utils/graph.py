@@ -137,25 +137,52 @@ class Graph:
     # -- scheduling --------------------------------------------------------
 
     def get_path(self, head: str | None = None) -> list[Node]:
-        """Deterministic execution order: DFS preorder from the head with
-        duplicate suppression -- a node runs when first reached.  Matches
-        the declared-order semantics of the reference scheduler."""
+        """Deterministic execution order: topological, with declaration
+        (DFS-preorder) order breaking ties.
+
+        The reference scheduler walks plain DFS preorder
+        (graph.py:59-79), which runs a fan-in node when FIRST reached --
+        before its remaining producers -- so in ``(a (b d) (c d))`` the
+        merge node d executes before c and can only see b's inputs.
+        Correct dataflow requires every producer to run first; here d
+        always runs after both b and c.
+        """
         if head is None:
             if not self._heads:
                 return []
             head = self._heads[0]
-        order: list[Node] = []
+        preorder: list[Node] = []
         seen: set[str] = set()
 
         def visit(node: Node):
             if node.name in seen:
                 return
             seen.add(node.name)
-            order.append(node)
+            preorder.append(node)
             for successor in node.successors:
                 visit(successor)
 
         visit(self._nodes[head])
+
+        # Kahn's algorithm restricted to reachable nodes, always taking
+        # the earliest ready node in declaration order.
+        reachable = {node.name for node in preorder}
+        order: list[Node] = []
+        emitted: set[str] = set()
+        remaining = list(preorder)
+        while remaining:
+            for index, node in enumerate(remaining):
+                ready = all(p.name in emitted
+                            for p in self.predecessors(node.name)
+                            if p.name in reachable)
+                if ready:
+                    emitted.add(node.name)
+                    order.append(node)
+                    del remaining[index]
+                    break
+            else:      # cycle among remaining: fall back to declaration
+                order.extend(remaining)
+                break
         return order
 
     def iterate_after(self, name: str, head: str | None = None) -> list[Node]:

@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Minimal Actor: say aloha (reference:
+examples/aloha_honua/aloha_honua_0.py:34-45).
+
+Run (no broker needed)::
+
+    python examples/aloha_honua/aloha_honua_0.py
+
+A remote caller (or this script itself, below) publishes
+``(aloha Pele)`` to the actor's ``topic/in`` and the method runs on the
+actor's mailbox.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+from aiko_services_tpu.runtime import init_process
+from aiko_services_tpu.services import Actor
+
+
+class AlohaHonua(Actor):
+    def __init__(self, name="aloha_honua", runtime=None):
+        super().__init__(name, "aloha_honua:0", runtime=runtime)
+
+    def aloha(self, name):
+        self.logger.info(f"Aloha {name}!")
+        print(f"Aloha {name}!")
+
+
+def main():
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    actor = AlohaHonua(runtime=runtime)
+
+    # Message the actor over the fabric, then stop after it's handled.
+    runtime.message.publish(f"{actor.topic_path}/in", "(aloha Pele)")
+    runtime.engine.add_oneshot_timer(runtime.terminate, 0.5)
+    runtime.run()
+
+
+if __name__ == "__main__":
+    main()

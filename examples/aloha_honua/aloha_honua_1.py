@@ -1,0 +1,47 @@
+#!/usr/bin/env python3
+"""Discovery + do_command: find the actor through the Registrar and call
+it by proxy (reference: examples/aloha_honua/aloha_honua_1.py:40-48).
+
+Run::
+
+    python examples/aloha_honua/aloha_honua_1.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+from aiko_services_tpu.runtime import init_process
+from aiko_services_tpu.services import (Actor, Registrar, ServiceFilter,
+                                        do_command)
+
+
+class AlohaHonua(Actor):
+    def __init__(self, name="aloha_honua", runtime=None):
+        super().__init__(name, "aloha_honua:0", runtime=runtime)
+        self.greeted = []
+
+    def aloha(self, name):
+        self.greeted.append(name)
+        print(f"Aloha {name}!")
+        if len(self.greeted) >= 1:
+            self.runtime.engine.add_oneshot_timer(
+                self.runtime.terminate, 0.2)
+
+
+def main():
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    Registrar(runtime=runtime, primary_search_timeout=0.1)
+    AlohaHonua(runtime=runtime)
+
+    # No topic paths anywhere: the caller only knows the protocol.
+    do_command(runtime, None, ServiceFilter(protocol="aloha_honua"),
+               lambda proxy: proxy.aloha("Honua"))
+    runtime.run(timeout=10.0)
+
+
+if __name__ == "__main__":
+    main()

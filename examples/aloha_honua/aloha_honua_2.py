@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""Request/response: ask the actor a question and collect the replies
+(reference: examples/aloha_honua/aloha_honua_3.py:41-98 do_request).
+
+Run::
+
+    python examples/aloha_honua/aloha_honua_2.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+from aiko_services_tpu.runtime import init_process
+from aiko_services_tpu.services import (Actor, Registrar, ServiceFilter,
+                                        do_request)
+from aiko_services_tpu.utils import generate
+
+
+class AlohaHonua(Actor):
+    def __init__(self, name="aloha_honua", runtime=None):
+        super().__init__(name, "aloha_honua:0", runtime=runtime)
+
+    def inquiry(self, response_topic, question):
+        publish = self.runtime.message.publish
+        publish(response_topic, generate("item_count", [2]))
+        publish(response_topic, generate("response", [question, "aloha"]))
+        publish(response_topic, generate("response", [question, "honua"]))
+
+
+def main():
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    Registrar(runtime=runtime, primary_search_timeout=0.1)
+    AlohaHonua(runtime=runtime)
+
+    def on_responses(items):
+        for command, parameters in items:
+            print(f"response: {parameters}")
+        runtime.engine.add_oneshot_timer(runtime.terminate, 0.2)
+
+    do_request(runtime, None, ServiceFilter(protocol="aloha_honua"),
+               lambda proxy, topic: proxy.inquiry(topic, "greeting"),
+               on_responses)
+    runtime.run(timeout=10.0)
+
+
+if __name__ == "__main__":
+    main()

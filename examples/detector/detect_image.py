@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Detection pipeline: read image -> JAX detector -> draw overlays ->
+write image (reference: examples/yolo/yolo.py YoloDetector + ImageOverlay
+on torch/CUDA; here the detector is the framework's own JAX model with
+weights in HBM -- BASELINE config 2).
+
+    python examples/detector/detect_image.py [input.png [output.png]]
+
+Without arguments a synthetic test image is generated first.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import queue
+
+import numpy as np
+
+from aiko_services_tpu.pipeline import Pipeline
+from aiko_services_tpu.runtime import init_process
+
+
+def definition(in_path, out_path):
+    def el(name, cls, inputs, outputs, parameters=None, module=None):
+        return {"name": name,
+                "input": [{"name": n} for n in inputs],
+                "output": [{"name": n} for n in outputs],
+                "parameters": parameters or {},
+                "deploy": {"local": {
+                    "module": module or "aiko_services_tpu.elements",
+                    "class_name": cls}}}
+    return {
+        "version": 0, "name": "detect_demo", "runtime": "jax",
+        "graph": ["(read detect overlay write)"],
+        "elements": [
+            el("read", "ImageReadFile", [], ["image"],
+               {"data_sources": [f"file://{in_path}"]}),
+            el("detect", "Detector", ["image"],
+               ["image", "overlay", "detections"],
+               {"score_threshold": 0.0}),     # random weights: show boxes
+            el("overlay", "ImageOverlay", ["image", "overlay"], ["image"]),
+            el("write", "ImageWriteFile", ["image"], [],
+               {"data_targets": [f"file://{out_path}"]}),
+        ]}
+
+
+def main():
+    in_path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/detect_in.png"
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "/tmp/detect_out.png"
+    if len(sys.argv) <= 1:
+        from PIL import Image
+        rng = np.random.default_rng(0)
+        Image.fromarray(rng.integers(0, 255, (96, 128, 3),
+                                     dtype=np.uint8)).save(in_path)
+        print(f"wrote synthetic input {in_path}")
+
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    pipeline = Pipeline(definition(in_path, out_path), runtime=runtime)
+    responses = queue.Queue()
+    pipeline.create_stream_local("1", queue_response=responses)
+    runtime.run(until=lambda: not responses.empty(), timeout=120.0)
+    _, _, swag, metrics, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    print(f"detections: {len(swag.get('detections', []))}, "
+          f"detector time {metrics.get('detect_time', 0) * 1e3:.1f} ms, "
+          f"output {out_path}")
+    runtime.terminate()
+
+
+if __name__ == "__main__":
+    main()

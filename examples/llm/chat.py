@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""Chat against an in-process LLMService (reference: examples/llm/
+elements.py LLM element backed by an external Ollama server; here the
+model is native JAX with continuous batching -- see
+aiko_services_tpu/elements/llm.py).
+
+    python examples/llm/chat.py "your prompt" [more prompts ...]
+
+All prompts decode CONCURRENTLY through one batched KV cache; token
+streams interleave on the wire.  With random tiny weights the output is
+gibberish bytes -- pass ``checkpoint=<orbax dir>`` via LLMService for a
+trained model.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+from aiko_services_tpu.elements import LLMService
+from aiko_services_tpu.runtime import init_process
+from aiko_services_tpu.services import get_service_proxy
+from aiko_services_tpu.utils import parse
+
+
+def main():
+    prompts = sys.argv[1:] or ["aloha", "honua"]
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    service = LLMService(runtime=runtime, max_slots=max(2, len(prompts)))
+    proxy = get_service_proxy(runtime, service.topic_path)
+
+    pending = set()
+    response_topic = f"{runtime.topic_path_process}/chat"
+
+    def on_reply(topic, payload):
+        command, parameters = parse(payload)
+        if command == "token":
+            print(f"[{parameters[0]}] +{parameters[1]!r}")
+        elif command == "complete":
+            print(f"[{parameters[0]}] DONE: {parameters[1]!r}")
+            pending.discard(parameters[0])
+
+    runtime.add_message_handler(on_reply, response_topic)
+    for index, prompt in enumerate(prompts):
+        request_id = f"req{index}"
+        pending.add(request_id)
+        proxy.generate(response_topic, request_id, prompt, 12, 0)
+
+    runtime.run(until=lambda: not pending, timeout=120.0)
+    runtime.terminate()
+
+
+if __name__ == "__main__":
+    main()

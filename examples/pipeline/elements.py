@@ -1,0 +1,64 @@
+"""Example PipelineElements (reference: examples/pipeline/elements.py:
+39-246 -- PE_Add, PE_RandomIntegers, fan-out/fan-in PEs, data codecs)."""
+
+from __future__ import annotations
+
+import random
+
+from aiko_services_tpu.pipeline import PipelineElement, StreamEvent
+
+
+class RandomIntegers(PipelineElement):
+    """Source: emits ``count`` random integers at ``rate`` frames/sec."""
+
+    def start_stream(self, stream, stream_id):
+        count = int(self.get_parameter("count", 10)[0])
+        seed = self.get_parameter("seed", None)[0]
+        rng = random.Random(int(seed)) if seed is not None else random.Random()
+
+        emitted = {"n": 0}
+
+        def frame_generator(stream):
+            if emitted["n"] >= count:
+                return StreamEvent.STOP, {"diagnostic": "all frames sent"}
+            emitted["n"] += 1
+            return StreamEvent.OKAY, {"x": rng.randint(0, 100)}
+
+        rate = self.get_parameter("rate", None)[0]
+        self.create_frames(stream, frame_generator,
+                           float(rate) if rate else None)
+        return StreamEvent.OKAY, {}
+
+    def process_frame(self, stream, **inputs):
+        return StreamEvent.OKAY, dict(inputs)
+
+
+class Add(PipelineElement):
+    """x -> x + constant (fan-out/fan-in demo arithmetic)."""
+
+    def process_frame(self, stream, x):
+        constant = int(self.get_parameter("constant", 1)[0])
+        return StreamEvent.OKAY, {"x": int(x) + constant}
+
+
+class Double(PipelineElement):
+    def process_frame(self, stream, x):
+        return StreamEvent.OKAY, {"y": int(x) * 2}
+
+
+class Square(PipelineElement):
+    def process_frame(self, stream, x):
+        return StreamEvent.OKAY, {"z": int(x) * int(x)}
+
+
+class Combine(PipelineElement):
+    """Fan-in: merge the two branch results."""
+
+    def process_frame(self, stream, y, z):
+        return StreamEvent.OKAY, {"result": int(y) + int(z)}
+
+
+class Print(PipelineElement):
+    def process_frame(self, stream, **inputs):
+        print(f"frame: {inputs}")
+        return StreamEvent.OKAY, dict(inputs)

@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Multitude scale test: N chained pipelines, frames flowing front-to-back
+through remote stages (reference: examples/pipeline/multitude/
+run_small.sh / run_large.sh, which chain 3/10 pipeline processes over
+mosquitto and top out near 50 frames/sec).
+
+    python examples/pipeline/multitude/run_multitude.py [N_pipelines] [frames]
+
+All pipelines share this process over the loopback broker (the same
+definitions distribute across processes over MQTT unchanged); each stage
+increments x, so a frame returning with x == N proves it traversed every
+pipeline.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..", "..")))
+
+import queue
+import sys
+import time
+
+from aiko_services_tpu.pipeline import Pipeline
+from aiko_services_tpu.runtime import init_process
+from aiko_services_tpu.services import Registrar
+
+
+def element(name, cls, inputs, outputs, parameters=None):
+    return {"name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "parameters": parameters or {},
+            "deploy": {"local": {
+                "module": "aiko_services_tpu.elements.common",
+                "class_name": cls}}}
+
+
+def remote(name, target, inputs, outputs):
+    return {"name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "deploy": {"remote": {"name": target}}}
+
+
+def main():
+    n_pipelines = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    n_frames = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    Registrar(runtime=runtime, primary_search_timeout=0.1)
+
+    # Tail pipeline first, then each one chains to the next.
+    names = [f"multitude_{i}" for i in range(n_pipelines)]
+    for i in reversed(range(n_pipelines)):
+        elements = [element("inc", "Increment", ["x"], ["x"])]
+        graph = "(inc)"
+        if i < n_pipelines - 1:
+            elements.append(remote("next", names[i + 1], ["x"], ["x"]))
+            graph = "(inc next)"
+        definition = {"version": 0, "name": names[i], "runtime": "jax",
+                      "graph": [graph], "elements": elements}
+        instance = Pipeline(definition, runtime=runtime)
+        if i == 0:
+            front = instance
+
+    responses = queue.Queue()
+    front.create_stream_local("1", queue_response=responses)
+
+    received = [0]
+    start = time.perf_counter()
+    for _ in range(n_frames):
+        front.ingest_local("1", {"x": 0}, queue_response=responses)
+
+    def drained():
+        while not responses.empty():
+            _, _, swag, _, okay, _ = responses.get()
+            assert okay and int(swag["x"]) == n_pipelines, swag
+            received[0] += 1
+        return received[0] >= n_frames
+
+    runtime.run(until=drained, timeout=120.0)
+    elapsed = time.perf_counter() - start
+    fps = received[0] / elapsed
+    print(f"{received[0]}/{n_frames} frames through {n_pipelines} chained "
+          f"pipelines in {elapsed:.2f}s = {fps:.0f} frames/sec "
+          f"(reference multitude ceiling: ~50 frames/sec)")
+    runtime.terminate()
+
+
+if __name__ == "__main__":
+    main()

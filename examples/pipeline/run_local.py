@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Run the fan-out/fan-in diamond pipeline and print each frame's result
+(reference: aiko_pipeline create pipeline_local.json).
+
+    python examples/pipeline/run_local.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import os
+import queue
+
+from aiko_services_tpu.pipeline import create_pipeline
+from aiko_services_tpu.runtime import init_process
+
+
+def main():
+    os.chdir(os.path.join(os.path.dirname(__file__), "..", ".."))
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    pipeline = create_pipeline("examples/pipeline/pipeline_local.json",
+                               runtime=runtime)
+    responses = queue.Queue()
+    pipeline.create_stream_local("1", queue_response=responses)
+
+    done = 0
+    while done < 5:
+        runtime.run(until=lambda: not responses.empty(), timeout=10.0)
+        if responses.empty():
+            break
+        _, frame_id, swag, metrics, okay, _ = responses.get()
+        print(f"frame {frame_id}: x={swag['x']} -> "
+              f"double={swag['y']} square={swag['z']} "
+              f"result={swag['result']} "
+              f"({metrics['time_pipeline'] * 1e3:.2f} ms)")
+        done += 1
+    runtime.terminate()
+
+
+if __name__ == "__main__":
+    main()

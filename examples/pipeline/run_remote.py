@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Remote pipeline stage: 'p_front' forwards each frame to 'p_worker'
+(discovered by name through the Registrar) and resumes when the worker's
+outputs return -- the framework's pause/resume continuation (reference:
+examples/pipeline/pipeline_remote.json + a second aiko_pipeline process).
+
+Both pipelines run in this one process over the loopback broker; with an
+MQTT broker the same two definitions run in separate processes/hosts
+unchanged.
+
+    python examples/pipeline/run_remote.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+import os
+import queue
+
+from aiko_services_tpu.pipeline import create_pipeline
+from aiko_services_tpu.runtime import init_process
+from aiko_services_tpu.services import Registrar
+
+
+def main():
+    os.chdir(os.path.join(os.path.dirname(__file__), "..", ".."))
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    Registrar(runtime=runtime, primary_search_timeout=0.1)
+
+    create_pipeline("examples/pipeline/pipeline_worker.json",
+                    runtime=runtime)
+    front = create_pipeline("examples/pipeline/pipeline_remote.json",
+                            runtime=runtime)
+
+    responses = queue.Queue()
+    front.create_stream_local("1", queue_response=responses)
+
+    done = 0
+    while done < 5:
+        runtime.run(until=lambda: not responses.empty(), timeout=15.0)
+        if responses.empty():
+            break
+        _, frame_id, swag, _, okay, diagnostic = responses.get()
+        print(f"frame {frame_id}: x={swag['x']} (worker added 100) "
+              f"okay={okay}")
+        done += 1
+    runtime.terminate()
+
+
+if __name__ == "__main__":
+    main()

@@ -15,7 +15,9 @@ def test_linear():
 def test_diamond():
     graph = Graph.traverse(["(a (b d) (c d))"])
     path = [n.name for n in graph.get_path()]
-    assert path == ["a", "b", "d", "c"]
+    # Topological: the fan-in node d runs only after BOTH producers
+    # (the reference's DFS preorder would run d before c).
+    assert path == ["a", "b", "c", "d"]
     assert {s.name for s in graph.get_node("a").successors} == {"b", "c"}
     assert [s.name for s in graph.get_node("b").successors] == ["d"]
     assert [s.name for s in graph.get_node("c").successors] == ["d"]

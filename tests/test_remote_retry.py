@@ -1,0 +1,53 @@
+"""Regression: a frame reaching a not-yet-discovered remote stage must
+retry FROM that stage -- earlier elements must not re-execute -- and must
+count as in-flight so graceful destroy does not drop it."""
+
+import queue
+
+from conftest import run_until
+
+from aiko_services_tpu.pipeline import Pipeline
+from aiko_services_tpu.services import Registrar
+
+
+def _element(name, cls):
+    return {"name": name, "input": [{"name": "x"}],
+            "output": [{"name": "x"}],
+            "deploy": {"local": {
+                "module": "aiko_services_tpu.elements.common",
+                "class_name": cls}}}
+
+
+def _remote(name, target):
+    return {"name": name, "input": [{"name": "x"}],
+            "output": [{"name": "x"}],
+            "deploy": {"remote": {"name": target}}}
+
+
+def test_frame_waits_for_remote_without_reexecution(runtime):
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    front = Pipeline({"version": 0, "name": "front", "runtime": "jax",
+                      "graph": ["(inc fwd)"],
+                      "elements": [_element("inc", "Increment"),
+                                   _remote("fwd", "back")]},
+                     runtime=runtime)
+    responses = queue.Queue()
+    front.create_stream_local("1", queue_response=responses)
+    # Ingest BEFORE the backend pipeline exists: the frame must park and
+    # retry, with inc having run exactly once.
+    front.ingest_local("1", {"x": 0}, queue_response=responses)
+    runtime.run(timeout=0.6)          # several retry cycles, no backend
+    assert front.streams["1"].in_flight == 1     # parked, not dropped
+
+    back = Pipeline({"version": 0, "name": "back", "runtime": "jax",
+                     "graph": ["(inc)"],
+                     "elements": [_element("inc", "Increment")]},
+                    runtime=runtime)
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    # front inc once (0 -> 1), back inc once (1 -> 2): NOT 3+.
+    assert int(swag["x"]) == 2, swag
+    assert front.streams["1"].in_flight == 0
+    front.stop()
+    back.stop()
