@@ -48,9 +48,19 @@ class DashboardModel:
     # -- directory ---------------------------------------------------------
 
     def services(self) -> list:
-        """ServiceRecords sorted by topic path (stable table order)."""
-        return sorted(self.cache.registry.all(),
-                      key=lambda record: record.topic_path)
+        """ServiceRecords sorted by topic path (stable table order).
+
+        Called from the UI thread while the engine thread mutates the
+        registry; retry on the rare mid-iteration resize rather than
+        crash the TUI (writes are engine-marshaled, reads are not).
+        """
+        for _ in range(4):
+            try:
+                return sorted(self.cache.registry.all(),
+                              key=lambda record: record.topic_path)
+            except RuntimeError:      # dict changed size during iteration
+                continue
+        return []
 
     # -- selection ---------------------------------------------------------
 
@@ -99,12 +109,17 @@ class DashboardModel:
     def share_items(self) -> list[tuple[str, str]]:
         def flatten(data, prefix=""):
             for key in sorted(data):
-                value = data[key]
+                value = data.get(key)
                 if isinstance(value, dict):
-                    yield from flatten(value, f"{prefix}{key}.")
+                    yield from flatten(dict(value), f"{prefix}{key}.")
                 else:
                     yield f"{prefix}{key}", str(value)
-        return list(flatten(self.share_view))
+        for _ in range(4):
+            try:
+                return list(flatten(dict(self.share_view)))
+            except RuntimeError:      # ECConsumer updating concurrently
+                continue
+        return []
 
     def terminate(self):
         self.deselect()

@@ -83,8 +83,10 @@ class LLMService(Actor):
         self.tokenizer = tokenizer or ByteTokenizer()
         self.batcher = ContinuousBatcher(params, config,
                                          max_slots=max_slots)
-        self._texts: dict[str, list[int]] = {}     # request_id -> tokens
-        self._reply_topics: dict[str, str] = {}
+        # Keyed by (response_topic, request_id): two callers independently
+        # choosing the same request_id (both starting at "1") must not
+        # collide -- the response topic is the caller's identity.
+        self._texts: dict[tuple[str, str], list[int]] = {}
         self._pumping = False
         self.share.update({"model_layers": config.n_layers,
                            "max_slots": max_slots,
@@ -96,11 +98,10 @@ class LLMService(Actor):
     def generate(self, response_topic, request_id, prompt,
                  max_new_tokens="128", temperature="0"):
         """(generate response_topic request_id prompt max tokens temp)"""
-        request_id = str(request_id)
-        self._texts[request_id] = []
-        self._reply_topics[request_id] = str(response_topic)
+        key = (str(response_topic), str(request_id))
+        self._texts[key] = []
         self.batcher.submit(Request(
-            request_id=request_id,
+            request_id="\x00".join(key),
             prompt_tokens=self.tokenizer.encode(str(prompt)),
             max_new_tokens=int(parse_number(max_new_tokens, 128)),
             temperature=float(parse_number(temperature, 0.0)),
@@ -126,22 +127,21 @@ class LLMService(Actor):
         else:
             self._pumping = False
 
-    def _on_token(self, request_id: str, token: int, finished: bool):
-        tokens = self._texts.setdefault(request_id, [])
-        reply_topic = self._reply_topics.get(request_id)
+    def _on_token(self, batcher_id: str, token: int, finished: bool):
+        reply_topic, _, request_id = batcher_id.partition("\x00")
+        key = (reply_topic, request_id)
+        tokens = self._texts.setdefault(key, [])
         if token not in self.tokenizer.eos_tokens:
             tokens.append(token)
-            if reply_topic:
-                fragment = self.tokenizer.decode([token])
-                self.runtime.message.publish(
-                    reply_topic,
-                    generate("token", [request_id, fragment]))
-        if finished and reply_topic:
+            fragment = self.tokenizer.decode([token])
+            self.runtime.message.publish(
+                reply_topic,
+                generate("token", [request_id, fragment]))
+        if finished:
             text = self.tokenizer.decode(tokens)
             self.runtime.message.publish(
                 reply_topic, generate("complete", [request_id, text]))
-            self._texts.pop(request_id, None)
-            self._reply_topics.pop(request_id, None)
+            self._texts.pop(key, None)
 
     # -- local API ---------------------------------------------------------
 

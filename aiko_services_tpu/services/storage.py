@@ -65,7 +65,11 @@ class Storage(Actor):
             publish(response_topic, generate("item_count", [0]))
             return
         publish(response_topic, generate("item_count", [1]))
-        publish(response_topic, f"(item {key} {row[0]})")
+        # row[0] is already codec text (stored via generate_value); the key
+        # must go through the codec too or spaces/parens/quotes in it would
+        # produce an unparseable S-expression.
+        publish(response_topic,
+                f"(item {generate_value(key)} {row[0]})")
 
     def erase(self, key):
         self._db.execute("DELETE FROM storage WHERE key = ?", (str(key),))

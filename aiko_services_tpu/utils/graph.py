@@ -50,6 +50,10 @@ class Graph:
     def __init__(self, heads: list[str] | None = None):
         self._nodes: dict[str, Node] = {}
         self._heads: list[str] = list(heads or [])
+        # get_path is O(V^2) worst case and pipelines call it per frame;
+        # graphs are immutable after construction, so memoize per head.
+        # Invalidated by add_node/_ensure (the construction entry points).
+        self._path_cache: dict[str, list[Node]] = {}
 
     # -- construction ------------------------------------------------------
 
@@ -98,6 +102,7 @@ class Graph:
         return head
 
     def _ensure(self, name: str, node_properties: dict) -> Node:
+        self._path_cache.clear()
         if name not in self._nodes:
             self._nodes[name] = Node(name,
                                      properties=node_properties.get(name))
@@ -151,6 +156,9 @@ class Graph:
             if not self._heads:
                 return []
             head = self._heads[0]
+        cached = self._path_cache.get(head)
+        if cached is not None:
+            return list(cached)
         preorder: list[Node] = []
         seen: set[str] = set()
 
@@ -183,7 +191,8 @@ class Graph:
             else:      # cycle among remaining: fall back to declaration
                 order.extend(remaining)
                 break
-        return order
+        self._path_cache[head] = order
+        return list(order)
 
     def iterate_after(self, name: str, head: str | None = None) -> list[Node]:
         """Nodes strictly after ``name`` in the execution path -- used to
