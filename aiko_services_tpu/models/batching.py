@@ -10,21 +10,24 @@ requests merge into device batches and de-multiplex back to per-request
 token streams.
 
 - ``max_slots`` sequences decode together as one [B] ``decode_step``;
-- new requests are prefix-filled with a batch-1 ``prefill`` into a scratch
-  cache, then scattered into their slot of the batched cache (jitted,
-  donated -- no host round-trip);
+- admission is CHUNKED and INTERLEAVED: each ``step()`` prefills at most
+  ``prefill_chunk`` prompt tokens -- written straight into the admitted
+  slot's region of the batched cache (``llama.prefill_into_slot``; no
+  scratch cache, no full-extent scatter) -- and then runs one decode
+  tick for every already-generating slot.  A long prompt therefore
+  never stalls active decodes beyond one chunk's latency, and admission
+  costs one in-place chunk write instead of a max_seq-extent copy;
 - finished sequences (EOS or token budget) free their slot immediately;
-  admission happens between decode steps, so a long generation never
-  blocks a short one (continuous, not static, batching);
+  a long generation never blocks a short one (continuous, not static,
+  batching);
 - the engine is synchronous and thread-agnostic: ``step()`` advances one
-  decode tick and returns emitted (request_id, token) pairs.  The serving
-  element runs it on a worker thread and pushes tokens to actor queues.
+  tick and invokes per-request ``emit`` callbacks.  The serving element
+  runs it on the event engine and pushes tokens to actor queues.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import time
 from functools import partial
 from typing import Callable
 
@@ -47,21 +50,9 @@ class Request:
     emit: Callable | None = None     # fn(request_id, token_id, finished)
     # runtime state
     slot: int = -1
+    prefill_pos: int = 0             # prompt tokens already written
     generated: int = 0
     done: bool = False
-
-
-@partial(jax.jit, donate_argnames=("big", ))
-def _scatter_cache(big: dict, small: dict, slot: jax.Array) -> dict:
-    """Copy a batch-1 prefill cache into slot ``slot`` of the batched
-    cache.  Copies the whole max_seq extent (prefill wrote only the
-    prompt's positions; the rest is zeros which decode masks out anyway
-    -- a static-shape copy XLA handles in one fused kernel)."""
-    k = jax.lax.dynamic_update_slice_in_dim(
-        big["k"], small["k"], slot, axis=1)
-    v = jax.lax.dynamic_update_slice_in_dim(
-        big["v"], small["v"], slot, axis=1)
-    return {"k": k, "v": v}
 
 
 @jax.jit
@@ -85,13 +76,15 @@ class ContinuousBatcher:
         self.config = config
         self.max_slots = max_slots
         self.max_seq = max_seq or config.max_seq
-        self.prefill_chunk = prefill_chunk
+        self.prefill_chunk = min(prefill_chunk, self.max_seq)
         self.cache = llama.init_cache(config, max_slots, self.max_seq)
         self.lengths = np.zeros(max_slots, dtype=np.int32)
         self.current = np.zeros(max_slots, dtype=np.int32)
         self.temperatures = np.zeros(max_slots, dtype=np.float32)
+        self.decoding = np.zeros(max_slots, dtype=bool)
         self.slots: list[Request | None] = [None] * max_slots
         self.pending: list[Request] = []
+        self._prefilling: list[int] = []      # slot FIFO, round-robin
         self._key = jax.random.PRNGKey(rng_seed)
         # perf counters
         self.tokens_emitted = 0
@@ -104,46 +97,70 @@ class ContinuousBatcher:
         if len(request.prompt_tokens) >= self.max_seq:
             request.prompt_tokens = \
                 request.prompt_tokens[-(self.max_seq // 2):]
-        self.pending.append(request)
-
-    def _free_slots(self) -> list[int]:
-        return [i for i, r in enumerate(self.slots) if r is None]
-
-    def _admit(self):
-        free = self._free_slots()
-        while free and self.pending:
-            slot = free.pop(0)
-            request = self.pending.pop(0)
-            self._prefill_into_slot(slot, request)
-
-    def _prefill_into_slot(self, slot: int, request: Request):
         # An empty prompt still needs one position of context to sample
         # from; condition it on a single pad token rather than indexing
         # into uninitialised padding.
         if not request.prompt_tokens:
             request.prompt_tokens = [0]
-        prompt = np.asarray(request.prompt_tokens, dtype=np.int32)
-        length = len(prompt)
-        # pad to the chunk grid to bound recompilation
-        padded = int(np.ceil(length / self.prefill_chunk)
-                     * self.prefill_chunk)
-        padded = min(padded, self.max_seq)
-        tokens = np.zeros((1, padded), dtype=np.int32)
-        tokens[0, :length] = prompt
-        scratch = llama.init_cache(self.config, 1, self.max_seq)
-        logits, scratch = llama.prefill(
-            self.params, self.config, jnp.asarray(tokens), scratch,
-            jnp.zeros((1,), dtype=jnp.int32))
-        self.cache = _scatter_cache(self.cache, scratch, jnp.int32(slot))
-        first = self._sample(logits[:, length - 1, :],
-                             request.temperature)
+        self.pending.append(request)
+
+    def _admit(self):
+        """Assign free slots to pending requests (no device work: the
+        prompt is written chunk-at-a-time by ``_prefill_tick``)."""
+        for slot, occupant in enumerate(self.slots):
+            if occupant is not None or not self.pending:
+                continue
+            request = self.pending.pop(0)
+            request.slot = slot
+            request.prefill_pos = 0
+            self.slots[slot] = request
+            self.lengths[slot] = 0
+            self.current[slot] = 0
+            self.temperatures[slot] = request.temperature
+            self.decoding[slot] = False
+            self._prefilling.append(slot)
+
+    def _prefill_tick(self):
+        """Write at most ONE chunk (<= prefill_chunk tokens) of the
+        longest-waiting admitting prompt into its slot's cache region.
+        Bounds the latency a decode tick can suffer from admissions."""
+        if not self._prefilling:
+            return
+        slot = self._prefilling.pop(0)
+        request = self.slots[slot]
+        if request is None:                     # cancelled while waiting
+            return
+        prompt = request.prompt_tokens
+        # Clamp the write start so a full chunk always fits inside the
+        # cache (a spilling dynamic_update_slice would clamp internally
+        # and corrupt earlier positions).  A clamped start re-writes the
+        # overlap with byte-identical KV (same tokens, same positions),
+        # so correctness is unaffected and only the final chunk pays.
+        start = min(request.prefill_pos, self.max_seq - self.prefill_chunk)
+        chunk_tokens = prompt[start:start + self.prefill_chunk]
+        # Always pad to the full chunk: one compiled shape for every
+        # admission.  Pad positions hold garbage KV, but decode writes
+        # each position before the length mask ever admits it, and the
+        # causal prefill mask never looks past the query position.
+        padded = np.zeros((1, self.prefill_chunk), dtype=np.int32)
+        padded[0, :len(chunk_tokens)] = chunk_tokens
+        logits, self.cache = llama.prefill_into_slot(
+            self.params, self.config, jnp.asarray(padded), self.cache,
+            jnp.int32(slot), jnp.int32(start))
+        self.prefill_tokens += start + len(chunk_tokens) \
+            - request.prefill_pos
+        request.prefill_pos = start + len(chunk_tokens)
+        if request.prefill_pos < len(prompt):
+            self._prefilling.append(slot)       # more chunks to go
+            return
+        # Final chunk: sample the first generated token from the last
+        # real prompt position's logits and hand the slot to decode.
+        last = len(prompt) - start - 1
+        first = self._sample(logits[:, last, :], request.temperature)
         first_token = int(jax.device_get(first)[0])
-        self.prefill_tokens += length
-        request.slot = slot
-        self.slots[slot] = request
-        self.lengths[slot] = length
+        self.lengths[slot] = len(prompt)
         self.current[slot] = first_token
-        self.temperatures[slot] = request.temperature
+        self.decoding[slot] = True
         self._emit(request, first_token)
 
     # -- decode ------------------------------------------------------------
@@ -155,27 +172,38 @@ class ContinuousBatcher:
         return llama.greedy_sample(logits)
 
     def step(self) -> int:
-        """Admit pending requests, run one decode tick across all active
-        slots, emit tokens.  Returns number of active slots."""
+        """Admit pending requests, advance at most one prefill chunk,
+        run one decode tick across all generating slots, emit tokens.
+        Returns the number of occupied slots (prefilling + decoding)."""
         self._admit()
-        active = [i for i, r in enumerate(self.slots) if r is not None]
-        if not active:
-            return 0
+        self._prefill_tick()
+        decoding = [i for i in range(self.max_slots) if self.decoding[i]]
+        if decoding:
+            self._decode_tick(decoding)
+        return sum(1 for r in self.slots if r is not None)
+
+    def _decode_tick(self, decoding: list[int]):
         tokens = jnp.asarray(self.current)
-        lengths = jnp.asarray(self.lengths)
+        # Rows not decoding (empty or mid-prefill) still flow through the
+        # batched step; route their KV write to the trash position
+        # max_seq-1, which real content never occupies (decode finishes
+        # at lengths >= max_seq-1, so its last write is max_seq-2, and
+        # the masks never admit max_seq-1 for a live row).
+        write_positions = np.where(self.decoding, self.lengths,
+                                   self.max_seq - 1).astype(np.int32)
         logits, self.cache = llama.decode_step(
-            self.params, self.config, tokens, self.cache, lengths)
+            self.params, self.config, tokens, self.cache,
+            jnp.asarray(write_positions))
         self._key, sub = jax.random.split(self._key)
         next_tokens = np.asarray(jax.device_get(_select_tokens(
             sub, logits, jnp.asarray(self.temperatures))), dtype=np.int32)
         self.steps += 1
-        for i in active:
+        for i in decoding:
             request = self.slots[i]
             self.lengths[i] += 1
             token = int(next_tokens[i])
             self.current[i] = token
             self._emit(request, token)
-        return len(active)
 
     def _emit(self, request: Request, token: int):
         request.generated += 1
@@ -187,10 +215,12 @@ class ContinuousBatcher:
             request.emit(request.request_id, token, finished)
         if finished:
             request.done = True
-            self.slots[request.slot] = None
-            self.lengths[request.slot] = 0
-            self.current[request.slot] = 0
-            self.temperatures[request.slot] = 0.0
+            slot = request.slot
+            self.slots[slot] = None
+            self.lengths[slot] = 0
+            self.current[slot] = 0
+            self.temperatures[slot] = 0.0
+            self.decoding[slot] = False
 
     # -- introspection -----------------------------------------------------
 

@@ -24,8 +24,8 @@ from ..ops.layers import (rms_norm, rope_frequencies, apply_rope, swiglu,
 from ..parallel.mesh import P
 
 __all__ = ["LlamaConfig", "init_params", "partition_specs",
-           "cache_specs", "init_cache", "prefill", "decode_step",
-           "greedy_sample"]
+           "cache_specs", "init_cache", "prefill", "prefill_into_slot",
+           "decode_step", "greedy_sample"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,10 +142,10 @@ def init_cache(config: LlamaConfig, batch: int,
             "v": jnp.zeros(shape, dtype=_dtype(c))}
 
 
-def _block(config: LlamaConfig, rope_table, hidden, layer, kv_write):
-    """One transformer block.  ``kv_write(k_new, v_new, k_layer, v_layer)
-    -> (k_layer, v_layer, k_all, v_all, lengths_mask)`` abstracts
-    prefill-vs-decode cache handling."""
+def _block(config: LlamaConfig, hidden, layer, kv_write):
+    """One transformer block.  ``kv_write(q, k, v) -> attn_out``
+    abstracts prefill-vs-decode cache handling (RoPE + cache write +
+    attention) and records the written cache on ``kv_write.updated``."""
     c = config
     b, s, _ = hidden.shape
     hd = c.head_dim
@@ -154,13 +154,38 @@ def _block(config: LlamaConfig, rope_table, hidden, layer, kv_write):
     q = (x @ layer["wq"]).reshape(b, s, c.n_heads, hd)
     k = (x @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
     v = (x @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
-    attn_out = kv_write(q, k, v, layer)
+    attn_out = kv_write(q, k, v)
     hidden = hidden + attn_out.reshape(b, s, c.n_heads * hd) @ layer["wo"]
 
     x = rms_norm(hidden, layer["mlp_norm"], c.norm_eps)
     hidden = hidden + swiglu(x, layer["w_gate"], layer["w_up"],
                              layer["w_down"])
     return hidden
+
+
+def _forward_layers(params: dict, config: LlamaConfig, hidden,
+                    cache: dict, kv_write_factory):
+    """Embed-to-logits scaffolding shared by the prefill/decode variants:
+    scan the stacked layers, final-norm, unembed.
+
+    ``kv_write_factory(k_layer, v_layer) -> kv_write`` builds the
+    per-layer cache-write-and-attend closure (see :func:`_block`).
+    Activation sharding follows from the param/cache input shardings via
+    SPMD propagation; serving/training wrappers pin in_shardings
+    explicitly (see models/train.py, tpu elements).
+    """
+    def layer_step(hidden, xs):
+        layer, k_layer, v_layer = xs
+        kv_write = kv_write_factory(k_layer, v_layer)
+        hidden2 = _block(config, hidden, layer, kv_write)
+        return hidden2, kv_write.updated
+
+    hidden, (k_new, v_new) = jax.lax.scan(
+        layer_step, hidden,
+        (params["layers"], cache["k"], cache["v"]))
+    hidden = rms_norm(hidden, params["final_norm"], config.norm_eps)
+    logits = hidden @ params["unembed"]
+    return logits, {"k": k_new, "v": v_new}
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -179,15 +204,8 @@ def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     positions = start_positions[:, None] + jnp.arange(s)[None, :]
 
-    # Activation sharding follows from the param/cache input shardings via
-    # SPMD propagation; serving/training wrappers pin in_shardings
-    # explicitly (see models/train.py, tpu elements).
-    hidden = params["embed"][tokens]                  # [B, S, D]
-
-    def layer_step(hidden, xs):
-        layer, k_layer, v_layer = xs
-
-        def kv_write(q, k, v, layer_p):
+    def factory(k_layer, v_layer):
+        def kv_write(q, k, v):
             q = apply_rope(q, rope_table, positions)
             k = apply_rope(k, rope_table, positions)
             # scatter chunk into the cache at [b, start+i]
@@ -198,16 +216,52 @@ def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
             k_all = repeat_kv(k_layer2, c.gqa_groups)
             v_all = repeat_kv(v_layer2, c.gqa_groups)
             return attention_prefill(q, k_all, v_all, positions)
+        return kv_write
 
-        hidden2 = _block(c, rope_table, hidden, layer, kv_write)
-        return hidden2, kv_write.updated
+    return _forward_layers(params, c, params["embed"][tokens], cache,
+                           factory)
 
-    hidden, (k_new, v_new) = jax.lax.scan(
-        layer_step, hidden,
-        (params["layers"], cache["k"], cache["v"]))
-    hidden = rms_norm(hidden, params["final_norm"], c.norm_eps)
-    logits = hidden @ params["unembed"]
-    return logits, {"k": k_new, "v": v_new}
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_into_slot(params: dict, config: LlamaConfig,
+                      tokens: jax.Array, cache: dict, slot: jax.Array,
+                      start: jax.Array) -> tuple[jax.Array, dict]:
+    """Process one prompt chunk for ONE sequence, writing its KV directly
+    into batch row ``slot`` of the BATCHED cache (no scratch cache, no
+    full-extent scatter -- the continuous batcher's admission path).
+
+    tokens: [1, S] chunk (right-padding allowed; pad positions are
+    overwritten by decode before the length mask ever admits them);
+    slot: scalar batch index; start: scalar cache offset of the chunk.
+    Queries attend the slot's whole cache row, so chunk N sees chunks
+    0..N-1 written by earlier calls.  Returns (logits [1, S, vocab],
+    cache) with the cache donated for in-place update.
+    """
+    c = config
+    rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    s = tokens.shape[1]
+    positions = start[None, None] + jnp.arange(s)[None, :]   # [1, S]
+
+    def factory(k_layer, v_layer):
+        def kv_write(q, k, v):
+            q = apply_rope(q, rope_table, positions)
+            k = apply_rope(k, rope_table, positions)
+            k_layer2 = jax.lax.dynamic_update_slice(
+                k_layer, k, (slot, start, 0, 0))
+            v_layer2 = jax.lax.dynamic_update_slice(
+                v_layer, v, (slot, start, 0, 0))
+            kv_write.updated = (k_layer2, v_layer2)
+            k_row = jax.lax.dynamic_slice(
+                k_layer2, (slot, 0, 0, 0), (1,) + k_layer.shape[1:])
+            v_row = jax.lax.dynamic_slice(
+                v_layer2, (slot, 0, 0, 0), (1,) + v_layer.shape[1:])
+            k_all = repeat_kv(k_row, c.gqa_groups)
+            v_all = repeat_kv(v_row, c.gqa_groups)
+            return attention_prefill(q, k_all, v_all, positions)
+        return kv_write
+
+    return _forward_layers(params, c, params["embed"][tokens], cache,
+                           factory)
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -224,12 +278,8 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     positions = lengths[:, None]                       # [B, 1]
 
-    hidden = params["embed"][tokens][:, None, :]       # [B, 1, D]
-
-    def layer_step(hidden, xs):
-        layer, k_layer, v_layer = xs
-
-        def kv_write(q, k, v, layer_p):
+    def factory(k_layer, v_layer):
+        def kv_write(q, k, v):
             q = apply_rope(q, rope_table, positions)
             k = apply_rope(k, rope_table, positions)
             batch_index = jnp.arange(b)
@@ -239,16 +289,11 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
             k_all = repeat_kv(k_layer2, c.gqa_groups)
             v_all = repeat_kv(v_layer2, c.gqa_groups)
             return attention_decode(q, k_all, v_all, lengths + 1)
+        return kv_write
 
-        hidden2 = _block(c, rope_table, hidden, layer, kv_write)
-        return hidden2, kv_write.updated
-
-    hidden, (k_new, v_new) = jax.lax.scan(
-        layer_step, hidden,
-        (params["layers"], cache["k"], cache["v"]))
-    hidden = rms_norm(hidden, params["final_norm"], c.norm_eps)
-    logits = hidden[:, 0, :] @ params["unembed"]
-    return logits, {"k": k_new, "v": v_new}
+    logits, new_cache = _forward_layers(
+        params, c, params["embed"][tokens][:, None, :], cache, factory)
+    return logits[:, 0, :], new_cache
 
 
 def greedy_sample(logits: jax.Array) -> jax.Array:

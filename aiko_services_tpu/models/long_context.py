@@ -52,7 +52,7 @@ def make_long_context_forward(config: llama.LlamaConfig, plan: MeshPlan,
         positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         hidden = params["embed"][tokens]
 
-        def cp_attention(q, k, v, layer):
+        def cp_attention(q, k, v):
             q = apply_rope(q, rope_table, positions)
             k = apply_rope(k, rope_table, positions)
             k = repeat_kv(k, c.gqa_groups)
@@ -61,8 +61,7 @@ def make_long_context_forward(config: llama.LlamaConfig, plan: MeshPlan,
                            batch_axis=batch_axis, head_axis=head_axis)
 
         def layer_step(hidden, layer):
-            return llama._block(c, rope_table, hidden, layer,
-                                cp_attention), None
+            return llama._block(c, hidden, layer, cp_attention), None
 
         hidden, _ = jax.lax.scan(layer_step, hidden, params["layers"])
         hidden = rms_norm(hidden, params["final_norm"], c.norm_eps)

@@ -27,10 +27,13 @@ class Node:
         self.element = element
         self.properties = properties or {}
         self.successors: list["Node"] = []
+        self._owner: "Graph | None" = None     # for path-cache invalidation
 
     def add_successor(self, node: "Node"):
         if node not in self.successors:
             self.successors.append(node)
+            if self._owner is not None:
+                self._owner._path_cache.clear()
 
     def __repr__(self):
         return (f"Node({self.name} -> "
@@ -104,8 +107,9 @@ class Graph:
     def _ensure(self, name: str, node_properties: dict) -> Node:
         self._path_cache.clear()
         if name not in self._nodes:
-            self._nodes[name] = Node(name,
-                                     properties=node_properties.get(name))
+            node = Node(name, properties=node_properties.get(name))
+            node._owner = self
+            self._nodes[name] = node
         return self._nodes[name]
 
     def add_node(self, name: str, element=None, properties=None) -> Node:
