@@ -93,6 +93,7 @@ class Pipeline(Actor):
         self.streams: dict[str, Stream] = {}
         self._current_stream_ref: Stream | None = None
         self._pipeline_parameters = dict(definition.parameters)
+        self.stage_placement = self._build_placement()
         self.graph = self._build_graph()
         self.share["element_count"] = len(self.graph)
         self.share["streams"] = 0
@@ -103,6 +104,38 @@ class Pipeline(Actor):
         self.add_hook("pipeline.process_element:0")
 
     # -- graph construction ------------------------------------------------
+
+    def _build_placement(self):
+        """Collect per-element ``placement`` blocks from the definition
+        into one :class:`StagePlacement` over the local devices, so a
+        definition file can express a multi-stage sharded pipeline
+        (BASELINE config 4).  Block forms: ``{"devices": N}`` (an N-chip
+        dp submesh) or ``{"mesh": {"tp": 4, ...}}``.  Elements without a
+        block share all local devices (the TPUElement default).
+
+        Frames hop between placed stages by ``StagePlacement.transfer``
+        in the frame loop -- a pure ICI reshard, no host round-trip
+        (the TPU analogue of the reference's remote-process deploy,
+        reference pipeline.py:246-258)."""
+        stages = {}
+        for element_def in self.definition.elements:
+            block = element_def.placement
+            if not block:
+                continue
+            if "mesh" in block:
+                stages[element_def.name] = dict(block["mesh"])
+            elif "devices" in block:
+                stages[element_def.name] = int(block["devices"])
+            else:
+                raise DefinitionError(
+                    f"element {element_def.name!r}: placement needs "
+                    f"'mesh' or 'devices', got {sorted(block)}")
+        if not stages:
+            return None
+        from .tensor import StagePlacement
+        placement = StagePlacement()
+        placement.assign(stages)
+        return placement
 
     def _build_graph(self) -> Graph:
         graph = Graph.traverse(self.definition.graph)
@@ -333,6 +366,13 @@ class Pipeline(Actor):
                         stream, frame,
                         f"{node.name}: missing inputs {missing}")
                     return
+                if self.stage_placement is not None \
+                        and node.name in self.stage_placement.plans:
+                    # Stage hop: reshard this stage's inputs onto its
+                    # submesh (device-to-device over ICI; a no-op when
+                    # already resident there).
+                    inputs = self.stage_placement.transfer(inputs,
+                                                           node.name)
                 self.run_hook("pipeline.process_element:0",
                               lambda: {"element": node.name,
                                        "frame": frame.frame_id})

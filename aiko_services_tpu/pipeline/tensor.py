@@ -222,9 +222,13 @@ class TPUElement(PipelineElement):
     def _resolve_placement(self) -> MeshPlan:
         placement, _ = self.get_parameter("placement", "local")
         placements = getattr(self.pipeline, "stage_placement", None)
-        if isinstance(placement, str) and placements is not None \
-                and placement in placements.plans:
-            return placements.plan(placement)
+        if placements is not None:
+            # A definition ``placement`` block registers the stage under
+            # the element's own node name; the ``placement`` parameter
+            # may also name another stage explicitly (shared submesh).
+            for key in (placement, self.name):
+                if isinstance(key, str) and key in placements.plans:
+                    return placements.plan(key)
         if isinstance(placement, dict):
             return MeshPlan(make_mesh(dict(placement)))
         devices = jax.devices()

@@ -130,6 +130,55 @@ def test_array_codec_roundtrip():
     assert decoded.dtype == x.dtype
 
 
+# -- definition-driven stage placement --------------------------------------
+
+def test_definition_placement_two_stage_pipeline(runtime):
+    """A definition file expresses a two-stage sharded pipeline: each
+    element's ``placement`` block lands it on a disjoint submesh, and
+    frames hop stages via StagePlacement.transfer (ICI reshard)."""
+    scale_def = element("Scale", "TensorScale", ["x"], ["x"],
+                        {"factor": 3.0})
+    scale_def["placement"] = {"mesh": {"dp": 4}}
+    sum_def = element("Sum", "TensorSum", ["x"], ["total"])
+    sum_def["placement"] = {"mesh": {"tp": 4}}
+    pipeline = Pipeline(definition(["(Scale Sum)"],
+                                   [scale_def, sum_def]),
+                        runtime=runtime)
+
+    placement = pipeline.stage_placement
+    assert placement is not None
+    assert dict(placement.plan("Scale").mesh.shape) == {"dp": 4}
+    assert dict(placement.plan("Sum").mesh.shape) == {"tp": 4}
+    scale_devices = set(placement.plan("Scale").mesh.devices.flat)
+    sum_devices = set(placement.plan("Sum").mesh.devices.flat)
+    assert not scale_devices & sum_devices        # disjoint submeshes
+
+    responses = queue.Queue()
+    pipeline.process_frame_local({"x": jnp.ones((4, 4))},
+                                 queue_response=responses)
+    run_until(runtime, lambda: not responses.empty())
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    assert float(swag["total"]) == 48.0
+    # Each element resolved ITS stage's mesh, not the local default.
+    assert dict(pipeline.graph.get_node("Scale").element.plan.mesh.shape) \
+        == {"dp": 4}
+    assert dict(pipeline.graph.get_node("Sum").element.plan.mesh.shape) \
+        == {"tp": 4}
+
+
+def test_definition_placement_overflow_rejected(runtime):
+    """Placement blocks requesting more chips than exist fail at
+    construction, not at frame time."""
+    scale_def = element("Scale", "TensorScale", ["x"], ["x"])
+    scale_def["placement"] = {"devices": 8}
+    sum_def = element("Sum", "TensorSum", ["x"], ["total"])
+    sum_def["placement"] = {"devices": 4}
+    with pytest.raises(ValueError, match="want"):
+        Pipeline(definition(["(Scale Sum)"], [scale_def, sum_def]),
+                 runtime=runtime)
+
+
 # -- tensor frames through a real pipeline ----------------------------------
 
 def test_tensor_pipeline_end_to_end(runtime):
