@@ -15,6 +15,7 @@ from .audio import (AudioReadFile, AudioWriteFile, AudioFraming,
                     write_wav)
 from .detect import Detector
 from .llm import LLM, LLMService, PROTOCOL_LLM
+from .speech import ASR, TTS
 from .observe import Inspect, Metrics
 from .expression import Expression, AllOutputs, evaluate_expression
 from .control import Loop

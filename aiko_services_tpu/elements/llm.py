@@ -35,6 +35,7 @@ import jax
 
 from ..models import llama
 from ..models.batching import ContinuousBatcher, Request
+from ..models.checkpoint import maybe_restore as _restore
 from ..models.tokenizer import ByteTokenizer, load_tokenizer
 from ..pipeline import PipelineElement, StreamEvent
 from ..services import Actor
@@ -45,14 +46,6 @@ __all__ = ["LLMService", "LLM", "PROTOCOL_LLM"]
 _logger = get_logger("aiko.llm")
 
 PROTOCOL_LLM = "llm:0"
-
-
-def _restore(params, checkpoint: str | None):
-    if checkpoint:
-        from ..models.checkpoint import restore_pytree
-        params = restore_pytree(checkpoint,
-                                template={"params": params})["params"]
-    return params
 
 
 def _collector(tokenizer, collected: list):

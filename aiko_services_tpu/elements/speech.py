@@ -1,0 +1,145 @@
+"""Speech pipeline elements: ASR (speech-to-text) and TTS
+(text-to-speech), hosting the framework's own JAX models in HBM
+(BASELINE config 5; reference equivalents:
+examples/speech/speech_elements.py PE_WhisperX at :203-239 wrapping the
+external whisperx/CUDA model, PE_COQUI_TTS at :122-146 wrapping Coqui
+VITS -- here both models are the framework's, models/asr.py and
+models/tts.py).
+
+Both elements resolve a ``checkpoint`` parameter (orbax directory, the
+same contract as the LLM/Detector elements) for fitted weights; without
+one they run from random init, which exercises every shape/compile path
+(the architecture is the deliverable -- see models/asr.py docstring).
+
+Audio longer than one ASR chunk is split into chunk-sized rows and
+transcribed as ONE batch: a single device dispatch, one compiled
+program, however long the utterance (the ShapeBucketer stance --
+never a data-dependent shape, always a padded batch).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models import asr as asr_model
+from ..models import tts as tts_model
+from ..models.checkpoint import maybe_restore
+from ..pipeline import PipelineElement, StreamEvent
+from ..pipeline.tensor import ShapeBucketer
+
+__all__ = ["ASR", "TTS"]
+
+
+def _chunk_rows(samples: np.ndarray, chunk: int,
+                bucketer: ShapeBucketer) -> np.ndarray:
+    """Mono waveform [N] -> [bucket(ceil(N/chunk)), chunk], zero
+    right-padded.  The row count is bucketed (powers of two from 1) so
+    ``transcribe`` compiles once per bucket, not once per utterance
+    length."""
+    samples = np.asarray(samples, dtype=np.float32).reshape(-1)
+    n_rows = bucketer.bucket(max(1, -(-len(samples) // chunk)))
+    rows = np.zeros((n_rows, chunk), dtype=np.float32)
+    flat = samples[: n_rows * chunk]
+    rows.reshape(-1)[: len(flat)] = flat
+    return rows
+
+
+class ASR(PipelineElement):
+    """``audio`` [N] or [N, C] + ``sample_rate`` -> transcript ``text``.
+
+    Parameters: ``checkpoint`` (orbax dir of fitted AsrConfig weights),
+    ``model_size`` (``tiny``/``base``), ``sample_rate`` (model rate,
+    default 16000).  Input audio at another rate should pass through
+    :class:`~aiko_services_tpu.elements.audio.AudioResampler` first
+    (same contract as the reference's resampler -> whisper chain).
+    """
+
+    _SIZES = {"tiny": asr_model.AsrConfig.tiny,
+              "base": asr_model.AsrConfig.base}
+
+    def __init__(self, context):
+        super().__init__(context)
+        self._params = None
+        self._config = None
+        self._bucketer = ShapeBucketer(minimum=1)
+
+    def _ensure_model(self):
+        if self._params is not None:
+            return
+        size, _ = self.get_parameter("model_size", "tiny")
+        if str(size) not in self._SIZES:
+            raise ValueError(f"ASR model_size {size!r}: expected one of "
+                             f"{sorted(self._SIZES)}")
+        self._config = self._SIZES[str(size)]()
+        seed, _ = self.get_parameter("seed", 0)
+        checkpoint, _ = self.get_parameter("checkpoint", None)
+        self._params = maybe_restore(
+            asr_model.init_params(jax.random.PRNGKey(int(seed)),
+                                  self._config),
+            checkpoint)
+
+    def process_frame(self, stream, audio=None, sample_rate=16000,
+                      **inputs):
+        try:
+            self._ensure_model()
+        except ValueError as error:
+            return StreamEvent.ERROR, {"diagnostic": str(error)}
+        config = self._config
+        if int(sample_rate) != config.sample_rate:
+            return StreamEvent.ERROR, {
+                "diagnostic": f"ASR expects {config.sample_rate} Hz audio"
+                              f", got {sample_rate} (add AudioResampler)"}
+        samples = np.asarray(audio, dtype=np.float32)
+        if samples.ndim == 2:                      # [N, C] -> mono
+            samples = samples.mean(axis=-1)
+        chunk = int(config.sample_rate * config.chunk_seconds)
+        rows = _chunk_rows(samples, chunk, self._bucketer)
+        tokens = asr_model.transcribe(self._params, config,
+                                      jnp.asarray(rows))
+        text = "".join(asr_model.decode_text(config, row)
+                       for row in np.asarray(tokens))
+        return StreamEvent.OKAY, {"text": text}
+
+
+class TTS(PipelineElement):
+    """``text`` -> ``audio`` waveform [N] + ``sample_rate``.
+
+    Parameters: ``checkpoint`` (orbax dir of fitted TtsConfig weights),
+    ``model_size`` (``tiny``/``base``), ``seed``.
+    """
+
+    _SIZES = {"tiny": tts_model.TtsConfig.tiny, "base": tts_model.TtsConfig}
+
+    def __init__(self, context):
+        super().__init__(context)
+        self._params = None
+        self._config = None
+
+    def _ensure_model(self):
+        if self._params is not None:
+            return
+        size, _ = self.get_parameter("model_size", "tiny")
+        if str(size) not in self._SIZES:
+            raise ValueError(f"TTS model_size {size!r}: expected one of "
+                             f"{sorted(self._SIZES)}")
+        self._config = self._SIZES[str(size)]()
+        seed, _ = self.get_parameter("seed", 0)
+        checkpoint, _ = self.get_parameter("checkpoint", None)
+        self._params = maybe_restore(
+            tts_model.init_params(jax.random.PRNGKey(int(seed)),
+                                  self._config),
+            checkpoint)
+
+    def process_frame(self, stream, text=None, **inputs):
+        try:
+            self._ensure_model()
+        except ValueError as error:
+            return StreamEvent.ERROR, {"diagnostic": str(error)}
+        waveform = tts_model.synthesize(self._params, self._config,
+                                        str(text))
+        return StreamEvent.OKAY, {
+            "audio": jnp.asarray(waveform),
+            "sample_rate": self._config.sample_rate}

@@ -31,7 +31,8 @@ except ImportError:                                # pragma: no cover
 
 from ..parallel.mesh import MeshPlan
 
-__all__ = ["Checkpointer", "save_pytree", "restore_pytree"]
+__all__ = ["Checkpointer", "save_pytree", "restore_pytree",
+           "maybe_restore"]
 
 class Checkpointer:
     """Step-numbered checkpoints under a root directory.
@@ -134,3 +135,13 @@ def save_pytree(directory, state: dict, metadata: dict | None = None):
 def restore_pytree(directory, template=None, plan=None, specs=None) -> dict:
     with Checkpointer(directory) as ckpt:
         return ckpt.restore(template=template, plan=plan, specs=specs)
+
+
+def maybe_restore(params, checkpoint: str | None):
+    """The model-hosting elements' checkpoint contract: ``params`` is the
+    freshly-initialized pytree (the restore template); if ``checkpoint``
+    names an orbax directory, the fitted weights replace it."""
+    if checkpoint:
+        params = restore_pytree(checkpoint,
+                                template={"params": params})["params"]
+    return params

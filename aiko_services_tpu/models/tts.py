@@ -144,9 +144,16 @@ def vocode(config: TtsConfig, mel: jax.Array) -> jax.Array:
     """
     c = config
     bank = jnp.asarray(_mel_filterbank_for(c))       # [bins, n_mels]
+    # Clamp to the normalized log-mel range (asr.log_mel maps into
+    # roughly [-1, 1]): unfitted weights can emit values whose
+    # exponentiation overflows float32 and NaNs Griffin-Lim.
+    mel = jnp.clip(mel, -4.0, 4.0)
     power = jnp.maximum(10.0 ** (mel * 4.0 - 4.0), 1e-10)
-    magnitude = jnp.sqrt(power @ jnp.linalg.pinv(bank).astype(mel.dtype))
-    magnitude = jnp.maximum(magnitude, 0.0)          # [B, F, bins]
+    # pinv(bank) has negative entries, so the reconstructed power can
+    # dip below zero -- clamp BEFORE the sqrt or it NaNs.
+    linear = jnp.maximum(power @ jnp.linalg.pinv(bank).astype(mel.dtype),
+                         0.0)
+    magnitude = jnp.sqrt(linear)                     # [B, F, bins]
 
     window = jnp.asarray(np.hanning(c.n_fft).astype(np.float32))
 
