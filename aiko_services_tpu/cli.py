@@ -185,6 +185,41 @@ def pipeline_validate(definition_pathname):
          "elements": definition.element_names()}, indent=2))
 
 
+# -- weight conversion ------------------------------------------------------
+
+@main.group()
+def convert():
+    """Ingest pretrained weights (HF safetensors -> framework orbax)."""
+
+
+@convert.command("llama")
+@click.argument("source")
+@click.argument("destination")
+@click.option("--max-seq", default=8192, help="serving context length")
+def convert_llama_cmd(source, destination, max_seq):
+    """Convert an HF Llama safetensors file/dir to an orbax checkpoint.
+
+    Afterwards: pipeline elements load it via the ``checkpoint``
+    parameter; ``LLMService(checkpoint=DESTINATION)`` serves it.
+    """
+    from .models.convert import convert_llama
+
+    config = convert_llama(source, destination, max_seq=max_seq)
+    click.echo(json.dumps({"destination": destination,
+                           "config": config.__dict__}))
+
+
+@convert.command("detector")
+@click.argument("source")
+@click.argument("destination")
+def convert_detector_cmd(source, destination):
+    """Convert a detector safetensors export to an orbax checkpoint."""
+    from .models.convert import convert_detector
+
+    convert_detector(source, destination)
+    click.echo(json.dumps({"destination": destination}))
+
+
 # -- dashboard --------------------------------------------------------------
 
 @main.command()

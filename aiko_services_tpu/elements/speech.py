@@ -96,11 +96,14 @@ class ASR(PipelineElement):
         if samples.ndim == 2:                      # [N, C] -> mono
             samples = samples.mean(axis=-1)
         chunk = int(config.sample_rate * config.chunk_seconds)
+        true_rows = max(1, -(-len(samples) // chunk))
         rows = _chunk_rows(samples, chunk, self._bucketer)
         tokens = asr_model.transcribe(self._params, config,
                                       jnp.asarray(rows))
+        # Decode only the real chunks -- bucket-padding rows are pure
+        # silence and a fitted model may still hallucinate tokens there.
         text = "".join(asr_model.decode_text(config, row)
-                       for row in np.asarray(tokens))
+                       for row in np.asarray(tokens)[:true_rows])
         return StreamEvent.OKAY, {"text": text}
 
 
@@ -134,6 +137,9 @@ class TTS(PipelineElement):
             checkpoint)
 
     def process_frame(self, stream, text=None, **inputs):
+        if text is None:
+            return StreamEvent.ERROR, {
+                "diagnostic": "TTS frame has no 'text' input"}
         try:
             self._ensure_model()
         except ValueError as error:

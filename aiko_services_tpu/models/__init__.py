@@ -1,6 +1,8 @@
+from . import convert
 from . import detector
 from . import llama
 from . import long_context
 from .batching import ContinuousBatcher, Request
-from .checkpoint import Checkpointer, save_pytree, restore_pytree
+from .checkpoint import (Checkpointer, save_pytree, restore_pytree,
+                         maybe_restore)
 from .tokenizer import ByteTokenizer, load_tokenizer

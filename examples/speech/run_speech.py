@@ -59,6 +59,9 @@ def main():
     responses = queue.Queue()
     pipeline.create_stream_local("1", queue_response=responses)
     runtime.run(until=lambda: not responses.empty(), timeout=120.0)
+    if responses.empty():
+        print("pipeline produced no response within 120 s")
+        return 1
 
     _, _, swag, metrics, okay, diagnostic = responses.get()
     if not okay:

@@ -13,7 +13,7 @@ from aiko_services_tpu.elements import write_wav
 from aiko_services_tpu.models import asr as asr_model
 from aiko_services_tpu.models import tts as tts_model
 from aiko_services_tpu.pipeline import Pipeline
-from test_media import definition, element, pump_stream
+from test_media import definition, element
 
 
 # -- ASR model --------------------------------------------------------------
