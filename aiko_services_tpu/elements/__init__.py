@@ -13,6 +13,9 @@ from .video import (VideoReadFile, VideoWriteFile, VideoSample,
 from .audio import (AudioReadFile, AudioWriteFile, AudioFraming,
                     AudioResampler, AudioFFT, AudioOutput, read_wav,
                     write_wav)
+from .audio_live import (MicrophoneRead, SpeakerWrite, DataSchemeMic,
+                         DataSchemeSpeaker)
+from .scheme_rtsp import DataSchemeRTSP, VideoReadRTSP
 from .detect import Detector
 from .llm import LLM, LLMService, PROTOCOL_LLM
 from .speech import ASR, TTS

@@ -1,0 +1,197 @@
+"""Live media endpoints: mic:// capture, speaker:// playback, rtsp://
+network-camera ingest -- driven by injected fake backends (the hardware
+backends, sounddevice / cv2-FFMPEG, are module hooks; reference
+audio_io.py:412-564, gstreamer/scheme_rtsp.py:27)."""
+
+import queue
+
+import numpy as np
+
+from conftest import run_until
+from aiko_services_tpu.elements import audio_live, scheme_rtsp
+from aiko_services_tpu.pipeline import Pipeline
+from test_media import definition, element
+
+
+class FakeMicBackend:
+    """Yields ``blocks`` then reports silence forever."""
+    instances: list = []
+
+    def __init__(self, device, sample_rate, block_samples, channels=1):
+        self.device = device
+        self.sample_rate = sample_rate
+        self.blocks = queue.Queue()
+        for i in range(3):
+            self.blocks.put_nowait(
+                np.full((block_samples, channels), 0.1 * (i + 1),
+                        dtype=np.float32))
+        self.closed = False
+        FakeMicBackend.instances.append(self)
+
+    def read(self, timeout=0.0):
+        try:
+            return self.blocks.get_nowait()
+        except queue.Empty:
+            return None
+
+    def close(self):
+        self.closed = True
+
+
+class FakeSpeakerBackend:
+    instances: list = []
+
+    def __init__(self, device, sample_rate, channels=1):
+        self.written = []
+        self.closed = False
+        FakeSpeakerBackend.instances.append(self)
+
+    def write(self, samples):
+        self.written.append(np.array(samples))
+
+    def close(self):
+        self.closed = True
+
+
+class FakeCapture:
+    """Three frames then end-of-stream."""
+    instances: list = []
+
+    def __init__(self, url):
+        self.url = url
+        self.remaining = 3
+        self.released = False
+        FakeCapture.instances.append(self)
+
+    def isOpened(self):
+        return True
+
+    def read(self):
+        if self.remaining <= 0:
+            return False, None
+        self.remaining -= 1
+        frame = np.zeros((8, 8, 3), dtype=np.uint8)
+        frame[:, :, 0] = 255              # BGR: blue channel saturated
+        return True, frame
+
+    def release(self):
+        self.released = True
+
+
+def test_microphone_to_speaker_pipeline(runtime, monkeypatch):
+    """mic:// blocks flow through the pipeline into speaker:// playback;
+    both backends open and close around the stream."""
+    monkeypatch.setattr(audio_live, "input_backend_factory",
+                        FakeMicBackend)
+    monkeypatch.setattr(audio_live, "output_backend_factory",
+                        FakeSpeakerBackend)
+    FakeMicBackend.instances.clear()
+    FakeSpeakerBackend.instances.clear()
+
+    pipeline = Pipeline(definition(
+        ["(Mic Play)"],
+        [element("Mic", "MicrophoneRead", [], ["audio", "sample_rate"],
+                 {"data_sources": "mic://default", "sample_rate": 8000,
+                  "block_samples": 160}),
+         element("Play", "SpeakerWrite", ["audio"], [],
+                 {"data_targets": "speaker://default",
+                  "sample_rate": 8000})],
+        name="p_mic"), runtime=runtime)
+    pipeline.create_stream_local("s1")
+    assert run_until(
+        runtime,
+        lambda: FakeSpeakerBackend.instances
+        and len(FakeSpeakerBackend.instances[0].written) >= 3,
+        timeout=15.0)
+
+    mic = FakeMicBackend.instances[0]
+    speaker = FakeSpeakerBackend.instances[0]
+    assert mic.sample_rate == 8000
+    np.testing.assert_allclose(speaker.written[0], 0.1, rtol=1e-6)
+    np.testing.assert_allclose(speaker.written[2], 0.3, rtol=1e-6)
+
+    pipeline.destroy_stream("s1")
+    assert run_until(runtime, lambda: mic.closed and speaker.closed,
+                     timeout=10.0)
+
+
+def test_microphone_open_failure_is_stream_error(runtime, monkeypatch):
+    def broken_factory(*args, **kwargs):
+        raise OSError("no such device")
+
+    monkeypatch.setattr(audio_live, "input_backend_factory",
+                        broken_factory)
+    pipeline = Pipeline(definition(
+        ["(Mic)"],
+        [element("Mic", "MicrophoneRead", [], ["audio"],
+                 {"data_sources": "mic://nope"})],
+        name="p_mic_err"), runtime=runtime)
+    # start_stream ERROR -> stream rejected synchronously (engine
+    # contract: create_stream_local returns None, stream not registered).
+    stream = pipeline.create_stream_local("s1")
+    assert stream is None
+    assert "s1" not in pipeline.streams
+
+
+def test_speaker_rejects_rate_mismatch(runtime, monkeypatch):
+    """Audio at a different rate than the opened device errors instead
+    of silently playing at the wrong speed."""
+    monkeypatch.setattr(audio_live, "output_backend_factory",
+                        FakeSpeakerBackend)
+    FakeSpeakerBackend.instances.clear()
+
+    pipeline = Pipeline(definition(
+        ["(Play)"],
+        [element("Play", "SpeakerWrite", ["audio", "sample_rate"], [],
+                 {"data_targets": "speaker://default",
+                  "sample_rate": 16000})],
+        name="p_spk_rate"), runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("s1", queue_response=responses)
+    pipeline.create_frame_local(
+        stream, {"audio": np.zeros(100, np.float32),
+                 "sample_rate": 48000})
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, _, _, okay, diagnostic = responses.get()
+    assert not okay
+    assert "48000" in diagnostic
+
+
+def test_rtsp_rejects_multiple_urls(runtime, monkeypatch):
+    monkeypatch.setattr(scheme_rtsp, "capture_factory", FakeCapture)
+    pipeline = Pipeline(definition(
+        ["(Rtsp)"],
+        [element("Rtsp", "VideoReadRTSP", [], ["image"],
+                 {"data_sources": ["rtsp://cam1/s", "rtsp://cam2/s"]})],
+        name="p_rtsp_multi"), runtime=runtime)
+    assert pipeline.create_stream_local("s1") is None
+
+
+def test_rtsp_source_decodes_frames(runtime, monkeypatch):
+    """rtsp:// frames arrive as RGB images; capture released at stop."""
+    monkeypatch.setattr(scheme_rtsp, "capture_factory", FakeCapture)
+    FakeCapture.instances.clear()
+
+    import tests_media_helpers
+    collected = tests_media_helpers.SINK = []
+
+    pipeline = Pipeline(definition(
+        ["(Rtsp Grab)"],
+        [element("Rtsp", "VideoReadRTSP", [], ["image"],
+                 {"data_sources": "rtsp://camera.local/stream1"}),
+         {"name": "Grab", "input": [{"name": "image"}], "output": [],
+          "deploy": {"local": {"module": "tests_media_helpers",
+                               "class_name": "Collect"}},
+          "parameters": {}}],
+        name="p_rtsp"), runtime=runtime)
+    pipeline.create_stream_local("s1")
+    assert run_until(runtime, lambda: len(collected) >= 3, timeout=15.0)
+
+    capture = FakeCapture.instances[0]
+    assert capture.url == "rtsp://camera.local/stream1"
+    first = np.asarray(collected[0])
+    assert first.shape == (8, 8, 3)
+    assert first[0, 0, 2] == 255          # BGR -> RGB flip happened
+    assert first[0, 0, 0] == 0
+    # End-of-stream (3 frames) stops the stream and releases capture.
+    assert run_until(runtime, lambda: capture.released, timeout=10.0)
