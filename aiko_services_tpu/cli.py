@@ -98,8 +98,11 @@ def pipeline():
               help="stream parameter NAME VALUE (repeatable)")
 @click.option("--frame-rate", "-fr", default=0.0,
               help="frame generator rate limit (frames/sec, 0 = max)")
+@click.option("--profile", "profile_dir", default=None,
+              help="write a jax.profiler trace (TensorBoard/xprof) to DIR "
+                   "with per-element TraceAnnotations while running")
 def pipeline_create(definition_pathname, transport, name, stream_id,
-                    frame_data, parameters, frame_rate):
+                    frame_data, parameters, frame_rate, profile_dir):
     """Create a Pipeline from DEFINITION_PATHNAME (JSON) and run it."""
     from .pipeline import create_pipeline
     from .utils import parse_value
@@ -107,20 +110,36 @@ def pipeline_create(definition_pathname, transport, name, stream_id,
     runtime = _runtime(transport)
     instance = create_pipeline(definition_pathname, name=name,
                                runtime=runtime)
-    if stream_id is not None or frame_data is not None:
-        stream_parameters = {key: value for key, value in parameters}
-        if frame_rate:
-            stream_parameters["rate"] = frame_rate
-        instance.create_stream_local(stream_id or "1", stream_parameters)
-        if frame_data:
-            data = parse_value(frame_data)
-            if not isinstance(data, dict):
-                raise click.BadParameter(
-                    "frame data must be an S-expression dictionary, "
-                    "e.g. '(x: 1)'")
-            instance.create_frame_local(
-                instance.streams[stream_id or "1"], data)
-    runtime.run()
+    profiler = None
+    if profile_dir:
+        from .tpu import Profiler
+
+        profiler = Profiler()
+        profiler.start(profile_dir)
+        profiler.attach(instance)
+    try:
+        if stream_id is not None or frame_data is not None:
+            stream_parameters = {key: value for key, value in parameters}
+            if frame_rate:
+                stream_parameters["rate"] = frame_rate
+            stream = instance.create_stream_local(stream_id or "1",
+                                                  stream_parameters)
+            if stream is None:
+                raise click.ClickException(
+                    f"stream {stream_id or '1'} rejected at start "
+                    "(element start_stream failed; see log)")
+            if frame_data:
+                data = parse_value(frame_data)
+                if not isinstance(data, dict):
+                    raise click.BadParameter(
+                        "frame data must be an S-expression dictionary, "
+                        "e.g. '(x: 1)'")
+                instance.create_frame_local(stream, data)
+        runtime.run()
+    finally:
+        if profiler is not None:
+            profiler.detach()
+            profiler.stop()
 
 
 @pipeline.command("list")

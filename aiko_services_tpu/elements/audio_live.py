@@ -18,6 +18,7 @@ working audio gets sounddevice automatically.
 from __future__ import annotations
 
 import queue
+import threading
 
 import numpy as np
 
@@ -91,6 +92,75 @@ input_backend_factory = SounddeviceInput
 output_backend_factory = SounddeviceOutput
 
 
+def _speaker_key(element_name: str) -> str:
+    # Single definition shared by DataSchemeSpeaker and SpeakerWrite.
+    return f"{element_name}.speaker_backend"
+
+
+def _device_id(path: str):
+    """``mic://1`` means PortAudio device *index* 1: sounddevice treats a
+    str as a name-substring match, so digit-only paths must become ints."""
+    return int(path) if path.isdigit() else path
+
+
+class _PlaybackPump:
+    """Writer thread between the engine and a (blocking) output backend.
+
+    ``OutputStream.write`` blocks for the real-time length of the samples;
+    running it on the single-threaded engine would stall every stream in
+    the process for the playback duration.  The pump mirrors the capture
+    pattern: the engine enqueues, a daemon thread drains."""
+
+    def __init__(self, backend, queue_depth: int = 64):
+        self._backend = backend
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_depth)
+        self._error: Exception | None = None
+        self._close_pending = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="aiko.speaker.pump")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            samples = self._queue.get()
+            if samples is None:
+                break
+            try:
+                self._backend.write(samples)
+            except Exception as error:
+                self._error = error
+        self._backend.close()       # sole closer: never races a write()
+
+    def write(self, samples: np.ndarray, timeout: float = 1.0):
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise RuntimeError(f"speaker backend failed: {error}")
+        try:
+            self._queue.put(samples, timeout=timeout)
+        except queue.Full:
+            raise RuntimeError(
+                "speaker backlog exceeded (producer faster than "
+                "real-time playback; add AudioSample or raise "
+                "queue_depth)") from None
+
+    def close(self):
+        """Signal the pump to finish and close the backend.  The backend
+        close always happens on the pump thread -- sounddevice/PortAudio
+        stream ops are not safe concurrently with an in-flight write --
+        so a stalled write can at worst leak the daemon thread, never
+        crash native code.  Bounded wait for the normal drain case."""
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:          # drop queued audio on shutdown
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._queue.put(None)
+        self._thread.join(timeout=2.0)
+
+
 @DataScheme.register("mic")
 class DataSchemeMic(DataScheme):
     """``mic://<device>`` -- opens a live capture backend and pumps its
@@ -107,7 +177,7 @@ class DataSchemeMic(DataScheme):
             return StreamEvent.ERROR, {
                 "diagnostic": f"mic:// takes exactly one device per "
                               f"element, got {len(data_sources)}"}
-        device = DataScheme.parse_data_url_path(data_sources[0])
+        device = _device_id(DataScheme.parse_data_url_path(data_sources[0]))
         sample_rate, _ = self.element.get_parameter("sample_rate", 16000)
         block, _ = self.element.get_parameter("block_samples", 1600)
         channels, _ = self.element.get_parameter("channels", 1)
@@ -146,23 +216,25 @@ class DataSchemeSpeaker(DataScheme):
 
     @property
     def _key(self) -> str:
-        return f"{self.element.name}.speaker_backend"
+        return _speaker_key(self.element.name)
 
     def create_targets(self, stream: Stream, data_targets):
         if len(data_targets) != 1:
             return StreamEvent.ERROR, {
                 "diagnostic": f"speaker:// takes exactly one device per "
                               f"element, got {len(data_targets)}"}
-        device = DataScheme.parse_data_url_path(data_targets[0])
+        device = _device_id(DataScheme.parse_data_url_path(data_targets[0]))
         sample_rate, _ = self.element.get_parameter("sample_rate", 16000)
         channels, _ = self.element.get_parameter("channels", 1)
+        queue_depth, _ = self.element.get_parameter("queue_depth", 64)
         try:
             backend = output_backend_factory(
                 device, int(sample_rate), int(channels))
         except Exception as error:
             return StreamEvent.ERROR, {
                 "diagnostic": f"speaker open failed: {error}"}
-        stream.variables[self._key] = backend
+        stream.variables[self._key] = _PlaybackPump(
+            backend, queue_depth=int(queue_depth))
         stream.variables[f"{self._key}.rate"] = int(sample_rate)
         return StreamEvent.OKAY, {}
 
@@ -185,7 +257,7 @@ class SpeakerWrite(DataTarget):
 
     def process_frame(self, stream: Stream, audio=None, sample_rate=None,
                       **inputs):
-        key = f"{self.name}.speaker_backend"
+        key = _speaker_key(self.name)
         backend = stream.variables.get(key)
         if backend is None:
             return StreamEvent.ERROR, {"diagnostic": "speaker not open"}

@@ -32,27 +32,58 @@ class _CaptureGuard:
     """Serializes read() vs release(): cv2.VideoCapture is not
     thread-safe, and destroy_sources (engine thread) would otherwise
     release the handle while the pump thread sits inside read() --
-    undefined behavior in native FFMPEG code.  release() waits for any
-    in-flight read to return; reads after release report end-of-stream."""
+    undefined behavior in native FFMPEG code.
+
+    release() must NOT wait for an in-flight read: RTSP reads can block
+    for tens of seconds (or forever) on a stalled camera, and release()
+    runs on the single-threaded engine that owns every stream in the
+    process.  So release() only *signals* and makes a brief attempt at
+    the native release; if the pump thread is inside read(), the pump
+    performs the native release itself as soon as the read returns.
+    Reads after release report end-of-stream."""
 
     def __init__(self, capture):
         self._capture = capture
         self._lock = threading.Lock()
-        self._released = False
+        self._released = threading.Event()
+        self._closed = False            # native release done (under lock)
 
     def read(self):
+        if self._released.is_set():
+            self._close(blocking=True)
+            return False, None
         with self._lock:
-            if self._released:
-                return False, None
-            return self._capture.read()
+            if self._released.is_set():
+                result = (False, None)
+            else:
+                result = self._capture.read()
+        if self._released.is_set():     # released while we were reading
+            self._close(blocking=True)
+            return False, None
+        return result
 
-    def release(self):
-        with self._lock:
-            if not self._released:
-                self._released = True
+    def release(self, timeout: float = 0.5):
+        """Engine-thread safe: returns within ``timeout`` even if the
+        pump thread is parked inside a stalled network read."""
+        self._released.set()
+        self._close(timeout=timeout)
+
+    def _close(self, blocking: bool = False, timeout: float = 0.0):
+        if blocking:
+            acquired = self._lock.acquire()
+        else:
+            acquired = self._lock.acquire(timeout=timeout) if timeout \
+                else self._lock.acquire(blocking=False)
+        if not acquired:
+            return      # reader owns the lock; it will close afterwards
+        try:
+            if not self._closed:
+                self._closed = True
                 release = getattr(self._capture, "release", None)
                 if release is not None:
                     release()
+        finally:
+            self._lock.release()
 
 
 def _default_capture_factory(url: str):
@@ -91,6 +122,9 @@ class DataSchemeRTSP(DataScheme):
                 "diagnostic": f"rtsp open failed: {error}"}
         opened = getattr(capture, "isOpened", lambda: True)()
         if not opened:
+            release = getattr(capture, "release", None)
+            if release is not None:     # free the native FFMPEG context
+                release()
             return StreamEvent.ERROR, {
                 "diagnostic": f"cannot open rtsp stream {url}"}
         stream.variables[self._key] = _CaptureGuard(capture)

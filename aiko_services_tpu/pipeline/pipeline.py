@@ -102,6 +102,7 @@ class Pipeline(Actor):
 
         self.add_hook("pipeline.process_frame:0")
         self.add_hook("pipeline.process_element:0")
+        self.add_hook("pipeline.process_element_post:0")
 
     # -- graph construction ------------------------------------------------
 
@@ -394,6 +395,12 @@ class Pipeline(Actor):
                 event, outputs = result if isinstance(result, tuple) \
                     else (result, {})
                 outputs = outputs or {}
+                self.run_hook("pipeline.process_element_post:0",
+                              lambda: {"element": node.name,
+                                       "frame": frame.frame_id,
+                                       "event": event,
+                                       "time":
+                                       frame.metrics[f"{node.name}_time"]})
 
                 if event == StreamEvent.OKAY and isinstance(
                         element, PipelineElementLoop):

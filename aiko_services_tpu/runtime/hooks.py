@@ -6,7 +6,9 @@ list of handlers, an enable flag and an invocation counter.  ``run_hook``
 takes a *lazily evaluated* closure producing the variables dict, so a
 disabled hook costs one dict lookup and a boolean test -- nothing is
 computed unless a handler is attached.  The TPU build also routes
-``jax.profiler`` trace annotations through hooks (see tpu/profiling)."""
+``jax.profiler`` trace annotations through hooks: see
+:mod:`aiko_services_tpu.tpu.profiling` (``Profiler.attach`` registers on
+``pipeline.process_element:0`` / ``pipeline.process_element_post:0``)."""
 
 from __future__ import annotations
 

@@ -1,0 +1,114 @@
+"""``jax.profiler`` integration, routed through the hook system.
+
+The reference instruments with hooks alone (reference:
+src/aiko_services/main/hook.py:19-23, pipeline.py:1286-1289); on TPU the
+interesting timeline lives in the XLA profiler, so this module bridges
+the two (SURVEY.md §5.1 TPU-equiv note):
+
+- :class:`Profiler` starts/stops a ``jax.profiler`` trace for the whole
+  process (viewable in TensorBoard / xprof) and, when attached to a
+  Pipeline, opens a ``jax.profiler.TraceAnnotation`` around every
+  element execution via the ``pipeline.process_element:0`` (enter) and
+  ``pipeline.process_element_post:0`` (exit) hooks — so each pipeline
+  element shows up as a named span on the host timeline, aligned with
+  the device ops it launched.
+- :func:`profile_trace` is the context-manager form for scripts/tests.
+
+CLI: ``python -m aiko_services_tpu pipeline create DEF --profile DIR``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..utils import get_logger
+
+__all__ = ["Profiler", "profile_trace"]
+
+_logger = get_logger("aiko.profiling")
+
+
+class Profiler:
+    """Process-wide trace plus per-element trace annotations.
+
+    The pipeline hot loop is single-threaded (one event engine owns all
+    element execution), so a plain stack of open annotations is enough;
+    a dangling annotation (element raised, so the post hook never fired)
+    is closed at the next enter or at ``detach()``.
+    """
+
+    def __init__(self):
+        self._logdir: str | None = None
+        self._pipelines: list = []
+        self._open: list[jax.profiler.TraceAnnotation] = []
+
+    @property
+    def active(self) -> bool:
+        return self._logdir is not None
+
+    # -- process-wide trace ------------------------------------------------
+
+    def start(self, logdir: str):
+        if self._logdir is not None:
+            _logger.warning("profiler already tracing to %s", self._logdir)
+            return
+        jax.profiler.start_trace(logdir)
+        self._logdir = logdir
+        _logger.info("jax.profiler trace -> %s", logdir)
+
+    def stop(self) -> str | None:
+        logdir, self._logdir = self._logdir, None
+        self._unwind()
+        if logdir is not None:
+            jax.profiler.stop_trace()
+        return logdir
+
+    # -- pipeline annotation hooks -----------------------------------------
+
+    def attach(self, pipeline):
+        """Annotate every element run of ``pipeline`` on the trace."""
+        pipeline.add_hook_handler("pipeline.process_element:0",
+                                  self._on_element)
+        pipeline.add_hook_handler("pipeline.process_element_post:0",
+                                  self._on_element_post)
+        self._pipelines.append(pipeline)
+
+    def detach(self):
+        for pipeline in self._pipelines:
+            pipeline.remove_hook_handler("pipeline.process_element:0",
+                                         self._on_element)
+            pipeline.remove_hook_handler("pipeline.process_element_post:0",
+                                         self._on_element_post)
+        self._pipelines.clear()
+        self._unwind()
+
+    def _on_element(self, component, hook, variables):
+        self._unwind()          # close a dangling span (element raised)
+        annotation = jax.profiler.TraceAnnotation(
+            f"element:{variables.get('element')}")
+        annotation.__enter__()
+        self._open.append(annotation)
+
+    def _on_element_post(self, component, hook, variables):
+        if self._open:
+            self._open.pop().__exit__(None, None, None)
+
+    def _unwind(self):
+        while self._open:
+            self._open.pop().__exit__(None, None, None)
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str, *pipelines):
+    """``with profile_trace("/tmp/trace", pipeline): ...``"""
+    profiler = Profiler()
+    profiler.start(logdir)
+    for pipeline in pipelines:
+        profiler.attach(pipeline)
+    try:
+        yield profiler
+    finally:
+        profiler.detach()
+        profiler.stop()

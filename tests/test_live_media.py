@@ -195,3 +195,97 @@ def test_rtsp_source_decodes_frames(runtime, monkeypatch):
     assert first[0, 0, 0] == 0
     # End-of-stream (3 frames) stops the stream and releases capture.
     assert run_until(runtime, lambda: capture.released, timeout=10.0)
+
+def test_rtsp_release_does_not_block_on_stalled_read():
+    """A stalled network read must not park release() (the engine
+    thread): release signals, returns fast, and the reader performs the
+    native release when the read finally returns."""
+    import threading
+    import time
+
+    from aiko_services_tpu.elements.scheme_rtsp import _CaptureGuard
+
+    release_gate = threading.Event()
+
+    class StalledCapture:
+        def __init__(self):
+            self.released = False
+
+        def read(self):
+            release_gate.wait(timeout=10.0)       # "network stall"
+            return True, np.zeros((2, 2, 3), np.uint8)
+
+        def release(self):
+            self.released = True
+
+    capture = StalledCapture()
+    guard = _CaptureGuard(capture)
+    results = []
+    reader = threading.Thread(target=lambda: results.append(guard.read()))
+    reader.start()
+    time.sleep(0.05)                              # reader inside read()
+
+    start = time.perf_counter()
+    guard.release(timeout=0.2)
+    elapsed = time.perf_counter() - start
+    assert elapsed < 2.0                          # returned promptly
+    assert not capture.released                   # deferred to reader
+
+    release_gate.set()                            # stall ends
+    reader.join(timeout=5.0)
+    assert results == [(False, None)]             # read reports EOS
+    assert capture.released                       # reader closed natively
+
+
+def test_playback_pump_keeps_engine_unblocked():
+    """SpeakerWrite playback goes through a writer thread: enqueueing is
+    fast even when the backend write is real-time slow, and close drains."""
+    import time
+
+    from aiko_services_tpu.elements.audio_live import _PlaybackPump
+
+    class SlowBackend:
+        def __init__(self):
+            self.written = []
+            self.closed = False
+
+        def write(self, samples):
+            time.sleep(0.05)                      # "real-time" playback
+            self.written.append(np.array(samples))
+
+        def close(self):
+            self.closed = True
+
+    backend = SlowBackend()
+    pump = _PlaybackPump(backend, queue_depth=8)
+    start = time.perf_counter()
+    for i in range(5):
+        pump.write(np.full(10, i, np.float32))
+    enqueue_time = time.perf_counter() - start
+    assert enqueue_time < 0.1                     # engine never waited
+    pump.close()
+    assert backend.closed
+    assert len(backend.written) >= 1              # playback happened
+
+
+def test_playback_pump_backlog_raises():
+    import time
+
+    class StuckBackend:
+        def write(self, samples):
+            time.sleep(10.0)
+
+        def close(self):
+            pass
+
+    from aiko_services_tpu.elements.audio_live import _PlaybackPump
+    pump = _PlaybackPump(StuckBackend(), queue_depth=1)
+    pump.write(np.zeros(4, np.float32))           # consumed by thread
+    pump.write(np.zeros(4, np.float32), timeout=0.05)   # fills queue
+    try:
+        pump.write(np.zeros(4, np.float32), timeout=0.05)
+        raised = False
+    except RuntimeError as error:
+        raised = True
+        assert "backlog" in str(error)
+    assert raised

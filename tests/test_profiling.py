@@ -1,0 +1,77 @@
+"""jax.profiler integration (SURVEY §5.1 TPU-equiv): process trace plus
+per-element TraceAnnotations driven by the pipeline hooks."""
+
+import os
+import queue
+
+from conftest import run_until
+from aiko_services_tpu.pipeline import Pipeline
+from aiko_services_tpu.tpu import Profiler, profile_trace
+
+ELEMENTS = "tests/pipeline_elements.py"
+
+
+def _definition():
+    def element(name, cls, inputs, outputs):
+        return {"name": name,
+                "input": [{"name": n} for n in inputs],
+                "output": [{"name": n} for n in outputs],
+                "deploy": {"local": {"module": ELEMENTS,
+                                     "class_name": cls}}}
+    return {"version": 0, "name": "p_prof", "runtime": "jax",
+            "graph": ["(A B)"],
+            "elements": [element("A", "ElementA", ["a"], ["a"]),
+                         element("B", "ElementB", ["a"], ["b"])]}
+
+
+def _run_frame(runtime, pipeline, frame_data):
+    responses = queue.Queue()
+    pipeline.process_frame_local(frame_data, queue_response=responses)
+    run_until(runtime, lambda: not responses.empty())
+    assert not responses.empty()
+
+
+def test_element_annotations_balanced(runtime, tmp_path):
+    pipeline = Pipeline(_definition(), runtime=runtime)
+    profiler = Profiler()
+    profiler.start(str(tmp_path / "trace"))
+    profiler.attach(pipeline)
+    try:
+        _run_frame(runtime, pipeline, {"a": 1})
+        _run_frame(runtime, pipeline, {"a": 2})
+    finally:
+        profiler.detach()
+        assert profiler._open == []     # every span closed
+        profiler.stop()
+    assert not profiler.active
+    # post hook fired once per element per frame
+    assert pipeline._hooks["pipeline.process_element_post:0"].count == 4
+    # a trace was actually written (plugins/profile/... under logdir)
+    produced = [os.path.join(root, f)
+                for root, _, files in os.walk(tmp_path) for f in files]
+    assert produced, "jax.profiler wrote no trace files"
+
+
+def test_profile_trace_context_manager(runtime, tmp_path):
+    pipeline = Pipeline(_definition(), runtime=runtime)
+    with profile_trace(str(tmp_path / "t2"), pipeline) as profiler:
+        assert profiler.active
+        _run_frame(runtime, pipeline, {"a": 3})
+    assert not profiler.active
+    assert profiler._pipelines == []
+
+
+def test_dangling_annotation_unwound(runtime, tmp_path):
+    """An element that raises skips the post hook; the profiler must not
+    leak the open span into the next element."""
+    definition = _definition()
+    definition["elements"][1]["deploy"]["local"]["class_name"] = "Raiser"
+    definition["graph"] = ["(A B)"]
+    pipeline = Pipeline(definition, runtime=runtime)
+    with profile_trace(str(tmp_path / "t3"), pipeline) as profiler:
+        responses = queue.Queue()
+        pipeline.process_frame_local({"a": 1}, queue_response=responses)
+        run_until(runtime, lambda: not responses.empty())
+        assert len(profiler._open) <= 1      # only B's dangling span
+        _run_frame(runtime, pipeline, {"a": 1})
+    assert profiler._open == []
