@@ -9,7 +9,11 @@ capability set):
 - tails the selected service's ``log`` topic;
 - publishes ``(update name value)`` to ``topic/control`` to change a
   share variable remotely (reference dashboard.py:552-700);
-- ``(stop)`` to ask a service to shut down.
+- ``(stop)`` to ask a service to shut down;
+- per-protocol/per-name **plugins** render service-specific views
+  (reference dashboard_plugins.py:1-52: plugin key = service name or
+  protocol): built-ins for the Registrar and Pipelines, extensible via
+  :func:`register_plugin`.
 
 ``DashboardModel`` is UI-free and fully testable offline; ``run_dashboard``
 is the curses front end polling at ~5 Hz (reference refresh rate,
@@ -21,15 +25,94 @@ from __future__ import annotations
 import collections
 
 from .runtime import init_process
-from .services import ECConsumer
+from .services import (ECConsumer, REGISTRAR_PROTOCOL,
+                       SERVICE_PROTOCOL_PREFIX)
 from .services.share import services_cache_singleton
 from .utils import generate, get_logger
 
-__all__ = ["DashboardModel", "run_dashboard"]
+__all__ = ["DashboardModel", "run_dashboard", "ServicePlugin",
+           "register_plugin", "plugin_for"]
 
 _logger = get_logger("aiko.dashboard")
 
 LOG_RING_SIZE = 256
+
+
+# ---------------------------------------------------------------------------
+# plugin registry (reference dashboard_plugins.py: keyed by service name
+# or protocol; name match wins)
+
+
+class ServicePlugin:
+    """A service-specific dashboard view.  Subclass, set ``title``, and
+    implement ``render(model, record) -> list[str]`` returning body lines
+    for the selected service (UI-free: the curses front end and any other
+    UI draw whatever lines the plugin produces)."""
+
+    title = "service"
+
+    def render(self, model: "DashboardModel", record) -> list[str]:
+        raise NotImplementedError
+
+
+_PLUGINS: dict[str, type[ServicePlugin]] = {}
+
+
+def register_plugin(key: str, plugin_class: type[ServicePlugin]):
+    """Key is a service *name* or a *protocol* string (exact match;
+    names take precedence when both match a selected service)."""
+    _PLUGINS[key] = plugin_class
+
+
+def plugin_for(record) -> ServicePlugin | None:
+    plugin_class = _PLUGINS.get(record.name) or _PLUGINS.get(record.protocol)
+    return plugin_class() if plugin_class is not None else None
+
+
+class RegistrarPlugin(ServicePlugin):
+    """Directory statistics: what the primary Registrar is tracking
+    (reference dashboard_plugins.py RegistrarFrame)."""
+
+    title = "registrar"
+
+    def render(self, model, record):
+        lines = [f"service_count: "
+                 f"{model.share_view.get('service_count', '?')}"]
+        by_protocol = collections.Counter(
+            r.protocol.rsplit("/", 1)[-1] for r in model.services())
+        lines.append("directory by protocol:")
+        for protocol, count in sorted(by_protocol.items()):
+            lines.append(f"  {protocol:24.24s} {count}")
+        return lines
+
+
+class PipelinePlugin(ServicePlugin):
+    """Pipeline vitals from its share dict: elements, streams, frame
+    counters, per-element parameters."""
+
+    title = "pipeline"
+
+    def render(self, model, record):
+        view = model.share_view
+        lines = [f"element_count: {view.get('element_count', '?')}",
+                 f"streams:       {view.get('streams', '?')}",
+                 f"frames:        {view.get('frames_processed', '?')}"]
+        extras = [(name, value) for name, value in model.share_items()
+                  if name.split(".")[0] not in
+                  ("element_count", "streams", "frames_processed",
+                   "lifecycle", "log_level", "running")]
+        if extras:
+            lines.append("element shares:")
+            lines.extend(f"  {name:32.32s} {value}"
+                         for name, value in extras)
+        return lines
+
+
+register_plugin(REGISTRAR_PROTOCOL, RegistrarPlugin)
+# Spelled out rather than importing PROTOCOL_PIPELINE: the pipeline
+# package pulls in jax, which a service browser doesn't need.  Equality
+# with the real constant is asserted in tests/test_dashboard_cli.py.
+register_plugin(f"{SERVICE_PROTOCOL_PREFIX}/pipeline:0", PipelinePlugin)
 
 
 class DashboardModel:
@@ -106,6 +189,27 @@ class DashboardModel:
         self.runtime.message.publish(f"{self.selected}/in",
                                      generate("stop", []))
 
+    def selected_record(self):
+        for record in self.services():
+            if record.topic_path == self.selected:
+                return record
+        return None
+
+    def plugin_view(self) -> tuple[str, list[str]] | None:
+        """(title, body lines) from the plugin matching the selected
+        service, or None when no plugin is registered for it."""
+        record = self.selected_record()
+        if record is None:
+            return None
+        plugin = plugin_for(record)
+        if plugin is None:
+            return None
+        try:
+            return plugin.title, plugin.render(self, record)
+        except Exception:
+            _logger.exception("plugin %s render failed", plugin.title)
+            return None
+
     def share_items(self) -> list[tuple[str, str]]:
         def flatten(data, prefix=""):
             for key in sorted(data):
@@ -156,7 +260,9 @@ def _dashboard_loop(stdscr, runtime, model):          # pragma: no cover
     stdscr.timeout(200)           # ~5 Hz refresh
     cursor = 0
     show_log = False
-    status = "q quit | enter select | l logs | u update | k stop service"
+    raw_view = False          # 'v': raw share dict instead of plugin view
+    status = ("q quit | enter select | l logs | v raw/plugin | u update "
+              "| k stop service")
 
     while True:
         records = model.services()
@@ -186,10 +292,18 @@ def _dashboard_loop(stdscr, runtime, model):          # pragma: no cover
             for i, line in enumerate(lines):
                 stdscr.addnstr(body_top + i, 0, line, width - 1)
         elif model.selected:
-            items = model.share_items()[:body_rows]
-            for i, (name, value) in enumerate(items):
-                stdscr.addnstr(body_top + i, 0,
-                               f"{name:32.32s} {value}", width - 1)
+            plugin_view = None if raw_view else model.plugin_view()
+            if plugin_view is not None and body_rows > 0:
+                title, lines = plugin_view
+                stdscr.addnstr(body_top, 0, f"[{title}]", width - 1,
+                               curses.A_BOLD)
+                for i, line in enumerate(lines[:max(0, body_rows - 1)]):
+                    stdscr.addnstr(body_top + 1 + i, 0, line, width - 1)
+            else:
+                items = model.share_items()[:body_rows]
+                for i, (name, value) in enumerate(items):
+                    stdscr.addnstr(body_top + i, 0,
+                                   f"{name:32.32s} {value}", width - 1)
         stdscr.addnstr(height - 1, 0, status.ljust(width - 1), width - 1,
                        curses.A_REVERSE)
         stdscr.refresh()
@@ -206,6 +320,8 @@ def _dashboard_loop(stdscr, runtime, model):          # pragma: no cover
                                 records[cursor].topic_path)
         elif key in (ord("l"), ord("L")):
             show_log = not show_log
+        elif key in (ord("v"), ord("V")):
+            raw_view = not raw_view
         elif key in (ord("u"), ord("U")) and model.selected:
             name_value = _prompt(stdscr, "update <name> <value>: ")
             parts = name_value.split(None, 1)

@@ -17,6 +17,7 @@ from .audio_live import (MicrophoneRead, SpeakerWrite, DataSchemeMic,
                          DataSchemeSpeaker)
 from .scheme_rtsp import DataSchemeRTSP, VideoReadRTSP
 from .detect import Detector
+from .vision import FaceDetect, ArucoMarkerDetect
 from .llm import LLM, LLMService, PROTOCOL_LLM
 from .speech import ASR, TTS
 from .observe import Inspect, Metrics

@@ -115,7 +115,6 @@ class _PlaybackPump:
         self._backend = backend
         self._queue: queue.Queue = queue.Queue(maxsize=queue_depth)
         self._error: Exception | None = None
-        self._close_pending = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="aiko.speaker.pump")
         self._thread.start()

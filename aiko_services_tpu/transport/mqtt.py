@@ -42,7 +42,15 @@ class MQTTMessage(Message):
                 "paho-mqtt not installed; use AIKO_TRANSPORT=loopback")
         super().__init__(message_handler, topics_subscribe,
                          lwt_topic, lwt_payload, lwt_retain)
-        self._config = configuration or get_mqtt_configuration()
+        # Probe: resolves through the candidate host list and fails fast
+        # with a precise diagnostic when no broker answers, instead of a
+        # slow paho connect timeout against a wrong AIKO_MQTT_HOST.
+        self._config = configuration or get_mqtt_configuration(probe=True)
+        if self._config.get("server_up") is False:
+            _logger.warning(
+                "no MQTT broker reachable (tried AIKO_MQTT_HOST / "
+                "AIKO_MQTT_HOSTS / localhost); connecting to %s:%s anyway",
+                self._config["host"], self._config["port"])
         self._connected_event = threading.Event()
         self._client = _paho.Client(
             _paho.CallbackAPIVersion.VERSION2

@@ -76,8 +76,11 @@ def load_class(qualified_name: str):
     return getattr(module, class_name)
 
 
-def find_free_port(start: int = 0) -> int:
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
+def find_free_port(start: int = 0, kind: str = "tcp") -> int:
+    """Kernel-assigned free port; ``kind`` is tcp or udp (reference
+    utilities/network.py:10-44 scans both families)."""
+    socket_type = socket.SOCK_DGRAM if kind == "udp" else socket.SOCK_STREAM
+    with socket.socket(socket.AF_INET, socket_type) as sock:
         sock.bind(("", start))
         return sock.getsockname()[1]
 

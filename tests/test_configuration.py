@@ -1,0 +1,105 @@
+"""Broker probing + UDP bootstrap discovery (reference
+configuration.py:104-186) over real loopback sockets."""
+
+import socket
+import threading
+
+from aiko_services_tpu.utils import (
+    bootstrap_discover, bootstrap_start, get_mqtt_host,
+    mqtt_broker_reachable)
+from aiko_services_tpu.utils.misc import find_free_port
+
+
+def listening_port():
+    """A real TCP listener standing in for a broker."""
+    server = socket.socket()
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+    return server, server.getsockname()[1]
+
+
+def test_broker_reachable_probe():
+    server, port = listening_port()
+    try:
+        assert mqtt_broker_reachable("127.0.0.1", port, timeout=1.0)
+    finally:
+        server.close()
+    assert not mqtt_broker_reachable("127.0.0.1", port, timeout=0.3)
+
+
+def test_get_mqtt_host_falls_through_candidate_list(monkeypatch):
+    """A dead AIKO_MQTT_HOST is skipped in favor of a live fallback from
+    AIKO_MQTT_HOSTS -- the reference's candidate-probing semantics."""
+    server, live_port = listening_port()
+    dead_port = find_free_port()
+    try:
+        monkeypatch.setenv("AIKO_MQTT_HOST", "127.0.0.1")
+        monkeypatch.setenv("AIKO_MQTT_PORT", str(dead_port))
+        monkeypatch.setenv("AIKO_MQTT_HOSTS",
+                           f"127.0.0.1:{live_port}")
+        server_up, host, port = get_mqtt_host(timeout=0.3)
+        assert server_up
+        assert (host, port) == ("127.0.0.1", live_port)
+    finally:
+        server.close()
+
+
+def test_get_mqtt_host_all_down_reports_primary(monkeypatch):
+    dead = find_free_port()
+    monkeypatch.setenv("AIKO_MQTT_HOST", "127.0.0.1")
+    monkeypatch.setenv("AIKO_MQTT_PORT", str(dead))
+    monkeypatch.delenv("AIKO_MQTT_HOSTS", raising=False)
+    server_up, host, port = get_mqtt_host(timeout=0.2)
+    assert not server_up
+    assert (host, port) == ("127.0.0.1", dead)
+
+
+def test_bootstrap_roundtrip(monkeypatch):
+    """boot? broadcast -> boot response carrying broker + namespace."""
+    monkeypatch.setenv("AIKO_NAMESPACE", "testspace")
+    udp_port = find_free_port(kind="udp")
+    stop = bootstrap_start(mqtt_host="broker.local", mqtt_port=1883,
+                           bind="127.0.0.1", port=udp_port)
+    try:
+        result = bootstrap_discover(server="127.0.0.1", port=udp_port,
+                                    timeout=3.0)
+        assert result == {"host": "broker.local", "port": 1883,
+                          "namespace": "testspace"}
+    finally:
+        stop.set()
+
+
+def test_bootstrap_discover_timeout():
+    assert bootstrap_discover(server="127.0.0.1",
+                              port=find_free_port(kind="udp"),
+                              timeout=0.3) is None
+
+
+def test_bootstrap_responder_ignores_garbage(monkeypatch):
+    """Malformed datagrams don't kill the responder thread."""
+    udp_port = find_free_port(kind="udp")
+    stop = bootstrap_start(mqtt_host="h", mqtt_port=1,
+                           bind="127.0.0.1", port=udp_port)
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as noise:
+            noise.sendto(b"\xff\xfe not a boot request",
+                         ("127.0.0.1", udp_port))
+            noise.sendto(b"boot? bad", ("127.0.0.1", udp_port))
+        result = bootstrap_discover(server="127.0.0.1", port=udp_port,
+                                    timeout=3.0)
+        assert result is not None and result["host"] == "h"
+    finally:
+        stop.set()
+
+
+def test_get_mqtt_host_skips_malformed_entries(monkeypatch):
+    server, live_port = listening_port()
+    try:
+        monkeypatch.delenv("AIKO_MQTT_HOST", raising=False)
+        monkeypatch.setenv("AIKO_MQTT_PORT", str(find_free_port()))
+        monkeypatch.setenv("AIKO_MQTT_HOSTS",
+                           f"broker:1883x, 127.0.0.1:{live_port}")
+        server_up, host, port = get_mqtt_host(timeout=0.3)
+        assert server_up and (host, port) == ("127.0.0.1", live_port)
+    finally:
+        server.close()

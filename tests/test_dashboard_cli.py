@@ -93,3 +93,55 @@ def test_cli_pipeline_validate_rejects_bad(tmp_path):
     path.write_text(json.dumps({"version": 0, "name": "x"}))
     result = CliRunner().invoke(main, ["pipeline", "validate", str(path)])
     assert result.exit_code != 0
+
+
+def test_dashboard_plugin_registry_and_registrar_view(runtime):
+    """Per-protocol plugins (reference dashboard_plugins.py:1-52):
+    protocol match, name-match precedence, and the built-in Registrar
+    view rendering directory statistics."""
+    from aiko_services_tpu.dashboard import (
+        RegistrarPlugin, ServicePlugin, plugin_for, register_plugin,
+        _PLUGINS)
+    from aiko_services_tpu.pipeline import PROTOCOL_PIPELINE
+
+    # The statically registered pipeline key matches the real constant.
+    assert PROTOCOL_PIPELINE in _PLUGINS
+
+    registrar = Registrar(runtime=runtime, primary_search_timeout=0.05)
+    Worker("worker_b", runtime=runtime)
+    model = DashboardModel(runtime)
+    assert run_until(
+        runtime, lambda: len(model.services()) >= 2, timeout=5.0)
+
+    registrar_record = next(r for r in model.services()
+                            if r.topic_path == registrar.topic_path)
+    assert isinstance(plugin_for(registrar_record), RegistrarPlugin)
+    worker_record = next(r for r in model.services()
+                         if r.name == "worker_b")
+    assert plugin_for(worker_record) is None      # no plugin registered
+
+    model.select(registrar.topic_path)
+    assert run_until(
+        runtime,
+        lambda: model.share_view.get("service_count") is not None,
+        timeout=5.0)
+    title, lines = model.plugin_view()
+    assert title == "registrar"
+    assert any("service_count" in line for line in lines)
+    assert any("registrar" in line for line in lines)   # by-protocol table
+
+    # Name-keyed plugin overrides a protocol-keyed one.
+    class NamePlugin(ServicePlugin):
+        title = "named"
+
+        def render(self, model, record):
+            return ["custom"]
+
+    register_plugin("worker_b", NamePlugin)
+    try:
+        assert isinstance(plugin_for(worker_record), NamePlugin)
+        model.select(worker_record.topic_path)
+        assert model.plugin_view() == ("named", ["custom"])
+    finally:
+        _PLUGINS.pop("worker_b", None)
+    model.terminate()

@@ -1,0 +1,33 @@
+"""The example scripts actually run: each aloha_honua demo (minimal
+actor, discovery/do_command, do_request) executes as a subprocess and
+produces its expected output -- examples are living documentation of the
+actor / discovery / request-response patterns (reference
+examples/aloha_honua/aloha_honua_{0..3}.py)."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+
+
+def run_example(relative, timeout=60):
+    result = subprocess.run(
+        [sys.executable, str(EXAMPLES / relative)],
+        capture_output=True, text=True, timeout=timeout,
+        env={"PATH": "/usr/bin:/bin", "AIKO_LOG_LEVEL": "ERROR",
+             "JAX_PLATFORMS": "cpu", "HOME": "/tmp"})
+    assert result.returncode == 0, result.stderr[-2000:]
+    return result.stdout
+
+
+@pytest.mark.parametrize("script,expected", [
+    ("aloha_honua/aloha_honua_0.py", "Aloha Pele!"),
+    ("aloha_honua/aloha_honua_1.py", "Aloha Honua!"),
+    ("aloha_honua/aloha_honua_2.py", "response:"),
+])
+def test_aloha_example(script, expected):
+    stdout = run_example(script)
+    assert expected in stdout, stdout
