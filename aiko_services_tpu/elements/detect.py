@@ -37,6 +37,11 @@ class Detector(TPUElement):
         self._config = None
         self._detect = None
 
+    def on_replacement(self):
+        super().on_replacement()
+        self._params = None             # _ensure_model reloads on the
+        self._detect = None             # replacement submesh
+
     def _ensure_model(self):
         if self._params is not None:
             return

@@ -103,6 +103,13 @@ class Pipeline(Actor):
         self.add_hook("pipeline.process_frame:0")
         self.add_hook("pipeline.process_element:0")
         self.add_hook("pipeline.process_element_post:0")
+        self.add_hook("pipeline.replacement:0")
+
+        self._health_timer = None
+        interval = self.definition.parameters.get("health_check_interval")
+        if interval and self.stage_placement is not None:
+            self._health_timer = self.runtime.engine.add_timer_handler(
+                self.check_device_health, float(interval))
 
     # -- graph construction ------------------------------------------------
 
@@ -137,6 +144,65 @@ class Pipeline(Actor):
         placement = StagePlacement()
         placement.assign(stages)
         return placement
+
+    def _cancel_health_timer(self):
+        if self._health_timer is not None:
+            self.runtime.engine.remove_timer_handler(self._health_timer)
+            self._health_timer = None
+
+    def check_device_health(self, prober=None) -> list:
+        """Probe the placement's devices; on failure, re-place stages on
+        the survivors (SURVEY.md §5.3 TPU-equiv: chip health checks +
+        stage re-placement).  Returns the failed devices (empty when all
+        healthy or no placement).  Schedule periodically via the
+        ``health_check_interval`` pipeline parameter (seconds)."""
+        if self.stage_placement is None:
+            return []
+        from ..tpu.health import probe_devices
+        failed = probe_devices(self.stage_placement.devices, prober)
+        if failed:
+            self.replace_failed_devices(failed)
+        return failed
+
+    def replace_failed_devices(self, failed_devices) -> None:
+        """Shrink/re-place every placed stage onto surviving devices and
+        tell the elements to drop plans + re-resolve weights
+        (``TPUElement.on_replacement``).
+
+        Unrecoverable failures (not enough survivors for one chip per
+        stage) are terminal: the health timer stops, the condition is
+        shared as ``placement_failed``, and every live stream errors --
+        an operator signal, not an every-interval retry of the
+        impossible."""
+        from .tensor import TPUElement
+
+        placement = self.stage_placement
+        self.logger.warning("re-placing stages: %d device(s) failed",
+                            len(failed_devices))
+        try:
+            placement.replace(failed_devices)
+        except RuntimeError as error:
+            self.logger.error("stage re-placement impossible: %s", error)
+            self._cancel_health_timer()
+            self.ec_producer.update("placement_failed", str(error))
+            for stream_id in list(self.streams):
+                stream = self.streams[stream_id]
+                for frame in list(stream.frames.values()):
+                    self._frame_error(stream, frame,
+                                      f"placement failed: {error}")
+                self._destroy_stream_now(stream_id)
+            return
+        for node in self.graph.nodes():
+            element = node.element
+            if isinstance(element, TPUElement):
+                element.on_replacement()
+        self.run_hook("pipeline.replacement:0",
+                      lambda: {"failed": [str(d) for d in failed_devices],
+                               "generation": placement.generation,
+                               "stages": {name: dict(plan.mesh.shape)
+                                          for name, plan
+                                          in placement.plans.items()}})
+        self.ec_producer.update("replacements", placement.generation)
 
     def _build_graph(self) -> Graph:
         graph = Graph.traverse(self.definition.graph)
@@ -618,6 +684,7 @@ class Pipeline(Actor):
         thread.start()
 
     def stop(self):
+        self._cancel_health_timer()
         for stream_id in list(self.streams):
             self._destroy_stream_now(stream_id)
         super().stop()

@@ -140,6 +140,8 @@ class StagePlacement:
         self.devices = list(devices if devices is not None
                             else jax.devices())
         self.plans: dict[str, MeshPlan] = {}
+        self._requests: dict[str, dict[str, int]] = {}
+        self.generation = 0             # bumped by every replace()
 
     def assign(self, stages: dict[str, dict[str, int] | int]) \
             -> dict[str, MeshPlan]:
@@ -147,17 +149,61 @@ class StagePlacement:
         requests = {}
         for name, want in stages.items():
             axes = {"dp": want} if isinstance(want, int) else dict(want)
-            count = int(np.prod(list(axes.values())))
-            requests[name] = (axes, count)
-        total = sum(count for _, count in requests.values())
+            requests[name] = axes
+        total = sum(int(np.prod(list(axes.values())))
+                    for axes in requests.values())
         if total > len(self.devices):
             raise ValueError(
                 f"stages want {total} devices, have {len(self.devices)}")
+        self._requests = requests
         cursor = 0
-        for name, (axes, count) in requests.items():
+        for name, axes in requests.items():
+            count = int(np.prod(list(axes.values())))
             chunk = self.devices[cursor:cursor + count]
             cursor += count
             self.plans[name] = MeshPlan(make_mesh(axes, chunk))
+        return self.plans
+
+    def replace(self, failed_devices: Sequence) -> dict[str, MeshPlan]:
+        """Re-place every stage onto the surviving devices (SURVEY.md
+        §5.3 TPU-equiv: re-shard onto surviving chips).
+
+        Failed devices leave the pool permanently; stage mesh requests
+        shrink by halving their largest axis (power-of-two steps keep
+        dp/tp/fsdp shardings valid) until the total fits the survivors.
+        Plans are rebuilt in place -- elements must drop cached plans
+        and re-put weights (``TPUElement.on_replacement``)."""
+        failed = set(failed_devices)
+        survivors = [d for d in self.devices if d not in failed]
+        if len(survivors) == len(self.devices):
+            return self.plans
+        if not survivors:
+            raise RuntimeError("no surviving devices to re-place onto")
+        requests = {name: dict(axes)
+                    for name, axes in self._requests.items()}
+
+        def total(reqs):
+            return sum(int(np.prod(list(axes.values())))
+                       for axes in reqs.values())
+
+        while total(requests) > len(survivors):
+            # Shrink the stage holding the most chips, on its largest
+            # axis; every request bottoms out at one chip.
+            name = max(requests,
+                       key=lambda n: int(np.prod(
+                           list(requests[n].values()))))
+            axes = requests[name]
+            axis = max(axes, key=axes.get)
+            if axes[axis] <= 1:
+                raise RuntimeError(
+                    f"cannot shrink stage {name!r} below one device "
+                    f"({len(survivors)} survivors for "
+                    f"{len(requests)} stages)")
+            axes[axis] = max(1, axes[axis] // 2)
+        self.devices = survivors
+        self.plans = {}
+        self.assign(requests)
+        self.generation += 1
         return self.plans
 
     def plan(self, stage: str) -> MeshPlan:
@@ -229,14 +275,34 @@ class TPUElement(PipelineElement):
             for key in (placement, self.name):
                 if isinstance(key, str) and key in placements.plans:
                     return placements.plan(key)
+        # Device pool: the StagePlacement's (which excludes chips removed
+        # by replace()) when one exists, else all local devices -- a
+        # default-placed element must never re-resolve onto a dead chip.
+        pool = list(placements.devices) if placements is not None \
+            else list(jax.devices())
         if isinstance(placement, dict):
-            return MeshPlan(make_mesh(dict(placement)))
-        devices = jax.devices()
-        return MeshPlan(make_mesh({"dp": len(devices)}, devices))
+            axes = dict(placement)
+            sizes = list(axes.values())
+            if -1 not in sizes and int(np.prod(sizes)) <= len(pool):
+                return MeshPlan(make_mesh(axes,
+                                          pool[:int(np.prod(sizes))]))
+            return MeshPlan(make_mesh(axes, pool))
+        return MeshPlan(make_mesh({"dp": len(pool)}, pool))
 
     def jit(self, fn: Callable) -> Callable:
         """Shape-keyed compiled cache for this element."""
         return self.jit_cache(fn)
+
+    def on_replacement(self):
+        """Devices were re-placed under this element (chip failure ->
+        ``StagePlacement.replace``): drop the cached plan and compiled
+        functions so the next frame resolves the new submesh and
+        recompiles there.  Model-hosting subclasses also drop their
+        resident weights, which rebuild lazily -- from the
+        ``checkpoint`` parameter when set, so recovery restores real
+        weights, not random init."""
+        self._plan = None
+        self.jit_cache = JitCache()
 
     def put(self, value, *spec):
         """Place an array (or pytree) on this element's mesh."""

@@ -1,0 +1,156 @@
+"""OODA-loop pipeline elements: Observe -> Orient -> Decide -> Act
+(reference: examples/robot/ooda/elements.py:36-197 PromptMediaFusion /
+RobotAgents / RobotActions).
+
+The agentic pattern: perception elements (Detector, ASR, text input)
+drop ``detections``/``texts`` into the swag; ``SensorFusion`` keeps a
+short-term detection memory per stream (orient), ``RobotAgents`` seeds
+each frame with the current world view (observe), and ``RobotActions``
+turns S-expression commands into remote method calls on a robot Actor
+discovered by service name (act) -- the same discovery/proxy machinery
+as every other service, so the robot can live in another process or on
+the real dog.
+
+Commands are table-driven (reference's if-chain, elements.py:103-160):
+``(forwards)``, ``(backwards)``, ``(turn left)``, ``(arm raise)``,
+``(hand open)``, ``(sit)``, ``(stop)``, ``(reset)``, ...
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+from aiko_services_tpu.pipeline import PipelineElement, StreamEvent
+from aiko_services_tpu.services import ServiceFilter, do_discovery
+from aiko_services_tpu.utils import parse
+
+__all__ = ["SensorFusion", "RobotAgents", "RobotActions"]
+
+DETECTION_MEMORY = 8          # frames a detection stays "oriented"
+
+
+class SensorFusion(PipelineElement):
+    """Merge fresh detections with a decaying per-stream memory
+    (reference PromptMediaFusion, elements.py:36-57: "remove old
+    detections, add new detections")."""
+
+    def start_stream(self, stream, stream_id):
+        stream.variables["fusion_memory"] = {}     # label -> frames left
+        return StreamEvent.OKAY, {}
+
+    def process_frame(self, stream, detections=None, texts=None):
+        memory: dict = stream.variables["fusion_memory"]
+        for label in list(memory):
+            memory[label] -= 1
+            if memory[label] <= 0:
+                del memory[label]
+        for detection in detections or []:
+            label = detection.get("class") if isinstance(detection, dict) \
+                else str(detection)
+            memory[label] = DETECTION_MEMORY
+        return StreamEvent.OKAY, {"detections": sorted(memory),
+                                  "texts": list(texts or [])}
+
+
+class RobotAgents(PipelineElement):
+    """Seed each frame with the current world view so downstream agents
+    always have ``detections``/``texts`` keys (reference RobotAgents,
+    elements.py:196-206 create_initial_value)."""
+
+    def process_frame(self, stream, **inputs):
+        return StreamEvent.OKAY, {
+            "detections": inputs.get("detections") or [],
+            "texts": inputs.get("texts") or []}
+
+
+# command word -> (method, fixed args) or a {qualifier: (method, args)}
+# table keyed by the second token (reference elements.py:103-160).
+COMMAND_TABLE = {
+    "forwards": ("move", ["x", 10]),
+    "backwards": ("move", ["x", -10]),
+    "turn": {"left": ("turn", [40]), "right": ("turn", [-40])},
+    "arm": {"lower": ("arm", [130, -40]), "raise": ("arm", [80, 80])},
+    "hand": {"open": ("claw", [0]), "close": ("claw", [255])},
+    "pitch": {"down": ("attitude", [15, 0, 0]),
+              "up": ("attitude", [0, 0, 0])},
+    "crawl": ("action", ["crawl"]),
+    "pee": ("action", ["pee"]),
+    "sit": ("action", ["sit"]),
+    "sniff": ("action", ["sniff"]),
+    "stretch": ("action", ["stretch"]),
+    "wag": ("action", ["wiggle_tail"]),
+    "stop": ("stop", []),
+    "reset": ("reset", []),
+}
+
+ALIASES = {"r": "(reset)", "s": "(stop)"}
+
+
+class RobotActions(PipelineElement):
+    """Discover the robot Actor named by the ``service_name`` parameter
+    and execute each frame's ``texts`` as robot commands (reference
+    RobotActions, elements.py:60-193).  Emits ``actions``:
+    ``[(text, status)]`` with status ok / unknown / no-robot."""
+
+    def start_stream(self, stream, stream_id):
+        service_name, found = self.get_parameter("service_name")
+        if not found:
+            return StreamEvent.ERROR, {
+                "diagnostic": "must provide 'service_name' parameter"}
+        stream.variables["robot_proxy"] = None
+
+        def on_add(record, proxy):
+            self.logger.info("discovered robot %s", record.topic_path)
+            stream.variables["robot_proxy"] = proxy
+
+        def on_remove(record, proxy):
+            self.logger.warning("lost robot %s", record.topic_path)
+            stream.variables["robot_proxy"] = None
+
+        stream.variables["robot_discovery"] = do_discovery(
+            self.pipeline.runtime,
+            ServiceFilter(name=str(service_name)), on_add, on_remove)
+        return StreamEvent.OKAY, {}
+
+    def _execute(self, robot, text: str) -> str:
+        command, parameters = parse(ALIASES.get(text, text))
+        if command == "action" and parameters:    # "(action sit)" form
+            command, parameters = str(parameters[0]), parameters[1:]
+        entry = COMMAND_TABLE.get(command)
+        if isinstance(entry, dict):
+            qualifier = str(parameters[0]) if parameters else ""
+            entry = entry.get(qualifier)
+        if entry is None:
+            return "unknown"
+        method, args = entry
+        getattr(robot, method)(*args)
+        return "ok"
+
+    def process_frame(self, stream, texts=None, **inputs):
+        actions = []
+        robot = stream.variables.get("robot_proxy")
+        for text in texts or []:
+            if not text:
+                continue
+            if robot is None:
+                actions.append((text, "no-robot"))
+                continue
+            try:
+                status = self._execute(robot, str(text))
+            except Exception as error:
+                self.logger.warning("command %r failed: %s", text, error)
+                status = "error"
+            actions.append((text, status))
+            self.logger.info("%s: %s", status, text)
+        return StreamEvent.OKAY, {"actions": actions}
+
+    def stop_stream(self, stream, stream_id):
+        discovery = stream.variables.pop("robot_discovery", None)
+        if discovery is not None:
+            discovery.terminate()
+        stream.variables.pop("robot_proxy", None)
+        return StreamEvent.OKAY, {}

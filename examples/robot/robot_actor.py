@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""VirtualRobot: an Actor with the XGO robot-dog command surface
+(reference: examples/xgo_robot/xgo_robot.py:110-221 XGORobot -- action /
+arm / attitude / claw / move / reset / stop / turn over the message
+fabric).  Instead of driving hardware it integrates a simple kinematic
+state into its ``share`` dict, so the Dashboard (or any ECConsumer)
+watches the robot move and tests assert on poses without a robot-dog on
+the desk.
+
+Run standalone::
+
+    python examples/robot/robot_actor.py        # + aiko_dashboard
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+from aiko_services_tpu.services import Actor
+
+PROTOCOL_ROBOT = "robot:0"
+
+ACTIONS = ("crawl", "pee", "sit", "sniff", "stretch", "wiggle_tail")
+
+
+class VirtualRobot(Actor):
+    """Kinematic twin of the reference's XGO robot-dog actor."""
+
+    def __init__(self, name="virtual_robot", runtime=None):
+        super().__init__(name, PROTOCOL_ROBOT, runtime=runtime)
+        for key, value in (("x", 0.0), ("y", 0.0), ("heading", 0.0),
+                           ("claw", 0), ("arm_x", 0), ("arm_z", 0),
+                           ("pitch", 0), ("roll", 0), ("yaw", 0),
+                           ("last_action", "none"), ("moving", False)):
+            self.share[key] = value
+
+    # -- the XGO command surface (each callable remotely by proxy) ----------
+
+    def action(self, value):
+        if value not in ACTIONS:
+            self.logger.warning("unknown action %r", value)
+            return
+        self.ec_producer.update("last_action", value)
+        self.ec_producer.update("moving", False)
+
+    def arm(self, x, z):
+        self.ec_producer.update("arm_x", int(x))
+        self.ec_producer.update("arm_z", int(z))
+
+    def attitude(self, pitch=0, roll=0, yaw=0):
+        self.ec_producer.update("pitch", int(pitch))
+        self.ec_producer.update("roll", int(roll))
+        self.ec_producer.update("yaw", int(yaw))
+
+    def claw(self, grip):
+        self.ec_producer.update("claw", int(grip))
+
+    def move(self, direction, stride=10):
+        """Integrate one stride in the body frame (x forward, y left)."""
+        stride = float(stride)
+        heading = math.radians(float(self.share["heading"]))
+        if direction == "x":
+            dx = stride * math.cos(heading)
+            dy = stride * math.sin(heading)
+        else:
+            dx = -stride * math.sin(heading)
+            dy = stride * math.cos(heading)
+        self.ec_producer.update("x", round(float(self.share["x"]) + dx, 3))
+        self.ec_producer.update("y", round(float(self.share["y"]) + dy, 3))
+        self.ec_producer.update("moving", True)
+
+    def reset(self):
+        for key in ("x", "y", "heading"):
+            self.ec_producer.update(key, 0.0)
+        for key in ("claw", "arm_x", "arm_z", "pitch", "roll", "yaw"):
+            self.ec_producer.update(key, 0)
+        self.ec_producer.update("last_action", "none")
+        self.ec_producer.update("moving", False)
+
+    def stop(self):
+        self.ec_producer.update("moving", False)
+
+    def turn(self, speed):
+        heading = (float(self.share["heading"]) + float(speed)) % 360.0
+        self.ec_producer.update("heading", heading)
+
+
+def main():
+    from aiko_services_tpu.runtime import init_process
+    from aiko_services_tpu.services import Registrar
+
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    Registrar(runtime=runtime, primary_search_timeout=0.1)
+    VirtualRobot(runtime=runtime)
+    runtime.run()
+
+
+if __name__ == "__main__":
+    main()

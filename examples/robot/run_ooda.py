@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""OODA demo: a VirtualRobot actor + the OODA pipeline in one process on
+the loopback fabric.  Operator text commands flow observe -> orient ->
+act and become remote method calls on the discovered robot; the robot's
+kinematic state (watchable live in aiko_dashboard) prints at the end.
+
+Run::
+
+    python examples/robot/run_ooda.py
+"""
+
+import os
+import queue
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+from aiko_services_tpu.pipeline import create_pipeline
+from aiko_services_tpu.runtime import init_process
+from aiko_services_tpu.services import Registrar
+
+from robot_actor import VirtualRobot
+
+
+def main():
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    Registrar(runtime=runtime, primary_search_timeout=0.1)
+    robot = VirtualRobot(runtime=runtime)
+
+    pipeline = create_pipeline(
+        os.path.join(os.path.dirname(__file__), "robot_pipeline.json"),
+        runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("1", queue_response=responses)
+
+    # Wait for the robot to be discovered, then issue the mission.
+    runtime.run(until=lambda: stream.variables.get("robot_proxy")
+                is not None, timeout=10.0)
+    mission = [{"texts": ["(forwards)", "(forwards)"],
+                "detections": [{"class": "oak_tree"}]},
+               {"texts": ["(turn left)"], "detections": []},
+               {"texts": ["(forwards)", "(sit)"], "detections": []}]
+    for frame_data in mission:
+        pipeline.create_frame_local(stream, frame_data)
+    done = []
+    # Proxy calls are asynchronous messages: wait for the robot's
+    # mailbox to drain (the last command is the sit), not just for the
+    # pipeline's frame responses.
+    runtime.run(until=lambda: responses.qsize() >= len(mission)
+                and robot.share["last_action"] == "sit", timeout=10.0)
+    while not responses.empty():
+        done.append(responses.get())
+    for _, _, swag, _, okay, _ in done:
+        print("actions:", swag.get("actions"),
+              "| oriented:", swag.get("Fusion.detections"))
+    print(f"robot pose: x={robot.share['x']} y={robot.share['y']} "
+          f"heading={robot.share['heading']} "
+          f"last_action={robot.share['last_action']}")
+
+
+if __name__ == "__main__":
+    main()

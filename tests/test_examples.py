@@ -27,6 +27,7 @@ def run_example(relative, timeout=60):
     ("aloha_honua/aloha_honua_0.py", "Aloha Pele!"),
     ("aloha_honua/aloha_honua_1.py", "Aloha Honua!"),
     ("aloha_honua/aloha_honua_2.py", "response:"),
+    ("robot/run_ooda.py", "last_action=sit"),
 ])
 def test_aloha_example(script, expected):
     stdout = run_example(script)

@@ -1,0 +1,100 @@
+"""OODA robot example family (reference examples/robot/ooda/
+elements.py:36-197, xgo_robot/xgo_robot.py:110-221): agentic pipeline
+driving a discovered robot Actor over the fabric."""
+
+import importlib.util
+import pathlib
+import queue
+import sys
+
+from conftest import run_until
+from aiko_services_tpu.pipeline import create_pipeline
+
+ROBOT_DIR = pathlib.Path(__file__).resolve().parent.parent \
+    / "examples" / "robot"
+
+
+def load_robot_actor():
+    spec = importlib.util.spec_from_file_location(
+        "robot_actor_test", ROBOT_DIR / "robot_actor.py")
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def build(runtime):
+    from aiko_services_tpu.services import Registrar
+
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    module = load_robot_actor()
+    robot = module.VirtualRobot(runtime=runtime)
+    pipeline = create_pipeline(str(ROBOT_DIR / "robot_pipeline.json"),
+                               runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("1", queue_response=responses)
+    assert run_until(
+        runtime,
+        lambda: stream.variables.get("robot_proxy") is not None,
+        timeout=10.0), "robot never discovered"
+    return robot, pipeline, stream, responses
+
+
+def test_commands_drive_discovered_robot(runtime):
+    robot, pipeline, stream, responses = build(runtime)
+    pipeline.create_frame_local(stream, {
+        "texts": ["(forwards)", "(turn left)", "(hand close)", "(sit)"],
+        "detections": [{"class": "octopus"}]})
+    assert run_until(runtime,
+                     lambda: robot.share["last_action"] == "sit",
+                     timeout=10.0)
+    assert robot.share["x"] == 10.0          # one stride before the turn
+    assert robot.share["heading"] == 40.0
+    assert robot.share["claw"] == 255
+    _, _, swag, _, okay, _ = responses.get()
+    assert okay
+    assert [status for _, status in swag["actions"]] == ["ok"] * 4
+    assert swag["Fusion.detections"] == ["octopus"]
+
+
+def test_unknown_and_aliased_commands(runtime):
+    robot, pipeline, stream, responses = build(runtime)
+    pipeline.create_frame_local(stream, {
+        "texts": ["(moonwalk)", "r"], "detections": []})
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, swag, _, okay, _ = responses.get()
+    assert okay
+    assert dict(swag["actions"])["(moonwalk)"] == "unknown"
+    assert dict(swag["actions"])["r"] == "ok"     # alias -> (reset)
+
+
+def test_no_robot_yet_reports_status(runtime):
+    """Commands before discovery degrade to no-robot, not a crash."""
+    pipeline = create_pipeline(str(ROBOT_DIR / "robot_pipeline.json"),
+                               runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("1", queue_response=responses)
+    pipeline.create_frame_local(stream, {"texts": ["(forwards)"],
+                                         "detections": []})
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, swag, _, okay, _ = responses.get()
+    assert okay
+    assert swag["actions"] == [("(forwards)", "no-robot")]
+
+
+def test_fusion_memory_decays(runtime):
+    robot, pipeline, stream, responses = build(runtime)
+    pipeline.create_frame_local(stream, {
+        "texts": [], "detections": [{"class": "oak_tree"}]})
+    for _ in range(9):                    # DETECTION_MEMORY = 8
+        pipeline.create_frame_local(stream, {"texts": [],
+                                             "detections": []})
+    assert run_until(runtime, lambda: responses.qsize() >= 10,
+                     timeout=10.0)
+    views = []
+    while not responses.empty():
+        _, _, swag, _, _, _ = responses.get()
+        views.append(swag["Fusion.detections"])
+    assert views[0] == ["oak_tree"]
+    assert views[7] == ["oak_tree"]       # still remembered
+    assert views[8] == []                 # decayed after 8 frames
