@@ -239,6 +239,27 @@ def convert_detector_cmd(source, destination):
     click.echo(json.dumps({"destination": destination}))
 
 
+# -- broker -----------------------------------------------------------------
+
+@main.command()
+@click.option("--port", default=1883, help="listen port (0 = assigned)")
+def broker(port):
+    """Run the in-tree native MQTT broker (mosquitto equivalent)."""
+    import time
+
+    from .transport import BrokerProcess
+
+    instance = BrokerProcess(port=port, export_env=False).start()
+    click.echo(f"mqtt broker listening on {instance.port}")
+    try:
+        while instance.process.poll() is None:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        instance.stop()
+
+
 # -- dashboard --------------------------------------------------------------
 
 @main.command()

@@ -55,6 +55,20 @@ class Registrar(Actor):
         self.share["service_count"] = 0
         self.share["state"] = self.state
 
+        # Stale-primary detection: a secondary probes the claimed
+        # primary; a retained ``(primary found)`` left behind by a
+        # process that died without its will firing (e.g. graceful
+        # disconnect mid-crash, broker restart) would otherwise pin
+        # every registrar in secondary forever -- the condition the
+        # reference clears by hand (reference scripts/system_reset.sh).
+        self._primary_topic: str | None = None
+        self._probe_pending = False
+        self._probe_timer = None
+        self._probe_interval = max(2.0, 2.0 * primary_search_timeout)
+        self._probe_topic = f"{self.topic_path}/probe"
+        self.runtime.add_message_handler(self._on_probe_response,
+                                         self._probe_topic)
+
         self.runtime.add_message_handler(
             self._on_boot_topic, self.runtime.topic_registrar_boot)
         self.runtime.add_message_handler(
@@ -114,8 +128,11 @@ class Registrar(Actor):
                     self.runtime.engine.remove_timer_handler(
                         self._search_timer)
                 self._set_state("secondary")
+                self._watch_primary(other_topic)
                 _logger.info("registrar %s is secondary to %s",
                              self.topic_path, other_topic)
+            elif self.state == "secondary":
+                self._watch_primary(other_topic)   # primary changed
             elif self.state == "primary":
                 # Fencing: deterministic conflict resolution.
                 mine = (self.promotion_timestamp or 0.0, self.topic_path)
@@ -135,6 +152,7 @@ class Registrar(Actor):
                     self._publish_found()
         elif parameters[0] == "absent":
             if self.state == "secondary":
+                self._stop_probe()
                 self._enter_primary_search()
             elif self.state == "primary":
                 # A demoted/buggy peer's will clobbered my live record:
@@ -146,6 +164,48 @@ class Registrar(Actor):
         self.runtime.message.remove_will("registrar_boot")
         self.registry = ServiceRegistry()
         self.share["service_count"] = 0
+
+    # -- stale-primary liveness probe --------------------------------------
+
+    def _watch_primary(self, primary_topic: str):
+        self._primary_topic = primary_topic
+        self._probe_pending = False
+        if self._probe_timer is None:
+            self._probe_timer = self.runtime.engine.add_timer_handler(
+                self._probe_primary, self._probe_interval)
+
+    def _stop_probe(self):
+        self._primary_topic = None
+        self._probe_pending = False
+        if self._probe_timer is not None:
+            self.runtime.engine.remove_timer_handler(self._probe_timer)
+            self._probe_timer = None
+
+    def _probe_primary(self):
+        if self.state != "secondary" or self._primary_topic is None:
+            self._stop_probe()
+            return
+        if self._probe_pending:
+            # A full interval passed with no answer: the retained
+            # record is stale.  Clear it for the whole namespace and
+            # stand for election.
+            _logger.warning(
+                "registrar %s: primary %s unresponsive; clearing stale "
+                "record and re-entering election",
+                self.topic_path, self._primary_topic)
+            self.runtime.message.publish(
+                self.runtime.topic_registrar_boot, "(primary absent)",
+                retain=True)
+            self._stop_probe()
+            self._enter_primary_search()
+            return
+        self._probe_pending = True
+        self.runtime.message.publish(
+            f"{self._primary_topic}/in",
+            generate("history", [self._probe_topic, 0]))
+
+    def _on_probe_response(self, topic: str, payload):
+        self._probe_pending = False
 
     # -- directory protocol (commands dispatched by the Actor layer) -------
 
@@ -195,7 +255,7 @@ class Registrar(Actor):
         response_topic = parameters[0]
         count = int(parse_number(parameters[1], 32)) \
             if len(parameters) > 1 else 32
-        entries = list(self._history)[-count:]
+        entries = list(self._history)[-count:] if count > 0 else []
         publish = self.runtime.message.publish
         publish(response_topic, generate("item_count", [len(entries)]))
         for action, record, timestamp in entries:
@@ -258,6 +318,9 @@ class Registrar(Actor):
                          len(removed), process_topic)
 
     def stop(self):
+        self._stop_probe()
+        self.runtime.remove_message_handler(self._on_probe_response,
+                                            self._probe_topic)
         if self.state == "primary":
             self.runtime.message.publish(
                 self.runtime.topic_registrar_boot, "(primary absent)",

@@ -3,6 +3,7 @@ from .castaway import CastawayMessage
 from .loopback import (LoopbackBroker, LoopbackMessage, get_broker,
                        reset_broker)
 from .mqtt import MQTTMessage, mqtt_available
+from .broker import BrokerProcess, broker_binary
 
 
 def create_transport(kind: str, **kwargs) -> Message:

@@ -1,11 +1,13 @@
-"""MQTT transport on paho-mqtt (reference: src/aiko_services/main/message/
+"""MQTT transport (reference: src/aiko_services/main/message/
 mqtt.py:66-300).
 
-Gated import: if paho-mqtt is not installed, constructing ``MQTTMessage``
-raises a clear error and callers fall back to the loopback transport.  This
-is the inter-host control plane only -- bulk tensor traffic never crosses
-MQTT in this framework (it rides ICI/DCN as jax.Arrays, or the socket
-data plane for host<->host hops).
+Client selection: paho-mqtt when installed, else the in-tree
+pure-stdlib client (:mod:`.mini_mqtt`) -- the MQTT control plane works
+with zero third-party packages, against any broker including the
+in-tree native one (:mod:`.broker`).  This is the inter-host control
+plane only -- bulk tensor traffic never crosses MQTT in this framework
+(it rides ICI/DCN as jax.Arrays, or the socket data plane for
+host<->host hops).
 """
 
 from __future__ import annotations
@@ -22,13 +24,22 @@ _logger = get_logger("aiko.mqtt")
 try:
     import paho.mqtt.client as _paho          # type: ignore
     _PAHO = True
-except ImportError:                           # pragma: no cover
+except ImportError:
     _paho = None
     _PAHO = False
 
 
 def mqtt_available() -> bool:
-    return _PAHO
+    return True                               # mini_mqtt is always there
+
+
+def _make_client():
+    if _PAHO:
+        return _paho.Client(
+            _paho.CallbackAPIVersion.VERSION2
+            if hasattr(_paho, "CallbackAPIVersion") else None)
+    from .mini_mqtt import Client
+    return Client()
 
 
 class MQTTMessage(Message):
@@ -37,9 +48,6 @@ class MQTTMessage(Message):
     def __init__(self, message_handler=None, topics_subscribe=None,
                  lwt_topic=None, lwt_payload=None, lwt_retain=False,
                  configuration: dict | None = None):
-        if not _PAHO:
-            raise RuntimeError(
-                "paho-mqtt not installed; use AIKO_TRANSPORT=loopback")
         super().__init__(message_handler, topics_subscribe,
                          lwt_topic, lwt_payload, lwt_retain)
         # Probe: resolves through the candidate host list and fails fast
@@ -52,9 +60,7 @@ class MQTTMessage(Message):
                 "AIKO_MQTT_HOSTS / localhost); connecting to %s:%s anyway",
                 self._config["host"], self._config["port"])
         self._connected_event = threading.Event()
-        self._client = _paho.Client(
-            _paho.CallbackAPIVersion.VERSION2
-            if hasattr(_paho, "CallbackAPIVersion") else None)
+        self._client = _make_client()
         self._client.on_connect = self._on_connect
         self._client.on_disconnect = self._on_disconnect
         self._client.on_message = self._on_message

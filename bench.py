@@ -362,13 +362,19 @@ def main() -> int:
         "device_platform": jax.devices()[0].platform,
         "chip_peak_bf16_flops": peak,
     }
-    for section in (bench_control,
-                    lambda: bench_detect(peak),
-                    lambda: bench_llm(peak)):
+    try:
+        rtt = measure_rtt()
+        record["dispatch_rtt_ms"] = round(rtt * 1000.0, 2)
+    except Exception as error:
+        record["rtt_error"] = f"{type(error).__name__}: {error}"
+        rtt = 0.0
+    for name, section in (
+            ("bench_control", bench_control),
+            ("bench_detect", lambda: bench_detect(peak, rtt)),
+            ("bench_llm", lambda: bench_llm(peak, rtt))):
         try:
             record.update(section())
         except Exception as error:          # keep the other sections
-            name = getattr(section, "__name__", "bench_model")
             record[f"{name}_error"] = f"{type(error).__name__}: {error}"
 
     control_fps = record.get("control_fps", 0.0)

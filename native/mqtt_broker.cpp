@@ -1,0 +1,378 @@
+// mqtt_broker: a single-file MQTT 3.1.1 broker for the aiko control
+// plane (the native-fabric role mosquitto plays for the reference --
+// reference scripts/system_start.sh:28-56 launches mosquitto; this
+// broker is in-tree so single-host deployments and integration tests
+// need no external daemon).
+//
+// Scope (exactly what the framework's control plane uses):
+//   - CONNECT/CONNACK (client id, clean session, keepalive, will
+//     topic/message/retain; username/password accepted and ignored)
+//   - PUBLISH QoS 0 and QoS 1 (PUBACK to the publisher; delivery to
+//     subscribers is downgraded to QoS 0 -- at-most-once fan-out)
+//   - retained messages (empty retained payload clears, MQTT-3.3.1-10)
+//   - SUBSCRIBE/SUBACK with '+' and trailing '#' wildcards, retained
+//     delivery on subscribe; UNSUBSCRIBE/UNSUBACK
+//   - PINGREQ/PINGRESP; DISCONNECT clears the will (MQTT-3.14.4-3)
+//   - last-will published on any abnormal disconnect -- the liveness
+//     signal the Registrar's failure detection rides on
+//
+// Single thread, poll(2) loop, no dependencies.  Not implemented (not
+// needed by the framework): QoS 2, session persistence, TLS (front
+// with stunnel/nginx if required), MQTT 5.
+//
+// Build:  g++ -O2 -std=c++17 -o mqtt_broker mqtt_broker.cpp
+// Run:    ./mqtt_broker [port]        (0 = kernel-assigned; the chosen
+//                                      port is printed as "LISTENING <port>")
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <set>
+#include <signal.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr size_t kMaxPacket = 4 * 1024 * 1024;   // headroom over the
+// control plane's largest payloads (share snapshots, base64 frames).
+
+struct Client {
+    int fd = -1;
+    std::string inbuf;
+    std::string outbuf;
+    std::string client_id;
+    std::set<std::string> filters;
+    bool connected = false;       // CONNECT processed
+    bool has_will = false;
+    std::string will_topic, will_payload;
+    bool will_retain = false;
+};
+
+std::map<int, Client> clients;                     // fd -> client
+std::map<std::string, std::string> retained;       // topic -> payload
+
+// -- topic matching ---------------------------------------------------------
+
+std::vector<std::string> split_levels(const std::string& path) {
+    std::vector<std::string> levels;
+    size_t start = 0;
+    for (;;) {
+        size_t slash = path.find('/', start);
+        if (slash == std::string::npos) {
+            levels.push_back(path.substr(start));
+            return levels;
+        }
+        levels.push_back(path.substr(start, slash - start));
+        start = slash + 1;
+    }
+}
+
+bool topic_matches(const std::string& filter, const std::string& topic) {
+    std::vector<std::string> flevels = split_levels(filter);
+    std::vector<std::string> tlevels = split_levels(topic);
+    for (size_t i = 0; i < flevels.size(); ++i) {
+        if (flevels[i] == "#") return true;        // rest of the topic
+        if (i >= tlevels.size()) return false;
+        if (flevels[i] != "+" && flevels[i] != tlevels[i]) return false;
+    }
+    return flevels.size() == tlevels.size();
+}
+
+// -- packet building --------------------------------------------------------
+
+void put_remaining_length(std::string& out, size_t length) {
+    do {
+        uint8_t digit = length % 128;
+        length /= 128;
+        if (length > 0) digit |= 0x80;
+        out.push_back(static_cast<char>(digit));
+    } while (length > 0);
+}
+
+std::string make_publish(const std::string& topic,
+                         const std::string& payload, bool retain) {
+    std::string packet;
+    packet.push_back(static_cast<char>(0x30 | (retain ? 0x01 : 0x00)));
+    std::string body;
+    body.push_back(static_cast<char>(topic.size() >> 8));
+    body.push_back(static_cast<char>(topic.size() & 0xff));
+    body += topic;
+    body += payload;                               // QoS 0: no packet id
+    put_remaining_length(packet, body.size());
+    packet += body;
+    return packet;
+}
+
+void queue_out(Client& client, const std::string& packet) {
+    client.outbuf += packet;
+}
+
+// -- routing ----------------------------------------------------------------
+
+void route_publish(const std::string& topic, const std::string& payload,
+                   bool retain) {
+    if (retain) {
+        if (payload.empty()) retained.erase(topic);
+        else retained[topic] = payload;
+    }
+    // Deliver with the retain flag CLEAR (it is a live message,
+    // MQTT-3.3.1-9).
+    std::string packet = make_publish(topic, payload, false);
+    for (auto& [fd, client] : clients) {
+        if (!client.connected) continue;
+        for (const auto& filter : client.filters) {
+            if (topic_matches(filter, topic)) {
+                queue_out(client, packet);
+                break;
+            }
+        }
+    }
+}
+
+void publish_will(Client& client) {
+    if (client.has_will) {
+        route_publish(client.will_topic, client.will_payload,
+                      client.will_retain);
+        client.has_will = false;
+    }
+}
+
+// -- packet parsing ---------------------------------------------------------
+
+uint16_t read_u16(const std::string& data, size_t offset) {
+    return (static_cast<uint8_t>(data[offset]) << 8)
+         | static_cast<uint8_t>(data[offset + 1]);
+}
+
+// Returns false when the client must be dropped (protocol error).
+bool handle_packet(Client& client, uint8_t header,
+                   const std::string& body) {
+    uint8_t type = header >> 4;
+    switch (type) {
+    case 1: {                                      // CONNECT
+        // variable header: proto name (len-prefixed), level, flags,
+        // keepalive -- then payload: client id [, will topic, will msg]
+        // [, username] [, password].
+        if (body.size() < 10) return false;
+        size_t name_length = read_u16(body, 0);
+        size_t at = 2 + name_length;               // skip protocol name
+        if (at + 4 > body.size()) return false;
+        at += 1;                                   // protocol level
+        uint8_t flags = static_cast<uint8_t>(body[at]); at += 1;
+        at += 2;                                   // keepalive
+        if (at + 2 > body.size()) return false;
+        size_t id_length = read_u16(body, at); at += 2;
+        if (at + id_length > body.size()) return false;
+        client.client_id = body.substr(at, id_length); at += id_length;
+        if (flags & 0x04) {                        // will flag
+            if (at + 2 > body.size()) return false;
+            size_t wt = read_u16(body, at); at += 2;
+            if (at + wt > body.size()) return false;
+            client.will_topic = body.substr(at, wt); at += wt;
+            if (at + 2 > body.size()) return false;
+            size_t wp = read_u16(body, at); at += 2;
+            if (at + wp > body.size()) return false;
+            client.will_payload = body.substr(at, wp); at += wp;
+            client.will_retain = (flags & 0x20) != 0;
+            client.has_will = true;
+        }
+        client.connected = true;
+        queue_out(client, std::string("\x20\x02\x00\x00", 4)); // CONNACK
+        return true;
+    }
+    case 3: {                                      // PUBLISH
+        uint8_t qos = (header >> 1) & 0x03;
+        bool retain = (header & 0x01) != 0;
+        if (body.size() < 2) return false;
+        size_t topic_length = read_u16(body, 0);
+        size_t at = 2 + topic_length;
+        if (at > body.size()) return false;
+        std::string topic = body.substr(2, topic_length);
+        if (qos > 0) {
+            if (at + 2 > body.size()) return false;
+            uint16_t packet_id = read_u16(body, at); at += 2;
+            std::string puback("\x40\x02", 2);     // PUBACK
+            puback.push_back(static_cast<char>(packet_id >> 8));
+            puback.push_back(static_cast<char>(packet_id & 0xff));
+            queue_out(client, puback);
+        }
+        route_publish(topic, body.substr(at), retain);
+        return true;
+    }
+    case 8: {                                      // SUBSCRIBE
+        if (body.size() < 2) return false;
+        uint16_t packet_id = read_u16(body, 0);
+        size_t at = 2;
+        std::vector<std::string> added;
+        while (at + 2 <= body.size()) {
+            size_t flen = read_u16(body, at); at += 2;
+            if (at + flen + 1 > body.size()) return false;
+            std::string filter = body.substr(at, flen);
+            at += flen + 1;                        // + requested QoS
+            client.filters.insert(filter);
+            added.push_back(filter);
+        }
+        std::string suback("\x90", 1);
+        std::string sbody;
+        sbody.push_back(static_cast<char>(packet_id >> 8));
+        sbody.push_back(static_cast<char>(packet_id & 0xff));
+        sbody.append(added.size(), '\x00');        // granted QoS 0
+        put_remaining_length(suback, sbody.size());
+        suback += sbody;
+        queue_out(client, suback);
+        for (const auto& filter : added)           // retained delivery
+            for (const auto& [topic, payload] : retained)
+                if (topic_matches(filter, topic))
+                    queue_out(client,
+                              make_publish(topic, payload, true));
+        return true;
+    }
+    case 10: {                                     // UNSUBSCRIBE
+        if (body.size() < 2) return false;
+        uint16_t packet_id = read_u16(body, 0);
+        size_t at = 2;
+        while (at + 2 <= body.size()) {
+            size_t flen = read_u16(body, at); at += 2;
+            if (at + flen > body.size()) return false;
+            client.filters.erase(body.substr(at, flen));
+            at += flen;
+        }
+        std::string unsuback("\xb0\x02", 2);
+        unsuback.push_back(static_cast<char>(packet_id >> 8));
+        unsuback.push_back(static_cast<char>(packet_id & 0xff));
+        queue_out(client, unsuback);
+        return true;
+    }
+    case 12:                                       // PINGREQ
+        queue_out(client, std::string("\xd0\x00", 2));
+        return true;
+    case 14:                                       // DISCONNECT
+        client.has_will = false;                   // graceful: no will
+        return false;                              // close connection
+    default:                                       // QoS2 flow etc.
+        return false;
+    }
+}
+
+// Drain complete packets from a client's input buffer.
+bool process_input(Client& client) {
+    for (;;) {
+        if (client.inbuf.size() < 2) return true;
+        uint8_t header = static_cast<uint8_t>(client.inbuf[0]);
+        size_t remaining = 0, multiplier = 1, at = 1;
+        bool length_complete = false;
+        while (at < client.inbuf.size() && at <= 4) {
+            uint8_t digit = static_cast<uint8_t>(client.inbuf[at]);
+            remaining += (digit & 0x7f) * multiplier;
+            multiplier *= 128;
+            at += 1;
+            if (!(digit & 0x80)) { length_complete = true; break; }
+        }
+        if (!length_complete)
+            return client.inbuf.size() <= 5;       // malformed if >5
+        if (remaining > kMaxPacket) return false;
+        if (client.inbuf.size() < at + remaining) return true;
+        std::string body = client.inbuf.substr(at, remaining);
+        client.inbuf.erase(0, at + remaining);
+        if (!handle_packet(client, header, body)) return false;
+    }
+}
+
+void drop_client(int fd, bool abnormal) {
+    auto it = clients.find(fd);
+    if (it == clients.end()) return;
+    if (abnormal) publish_will(it->second);
+    close(fd);
+    clients.erase(it);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    signal(SIGPIPE, SIG_IGN);
+    int port = argc > 1 ? atoi(argv[1]) : 1883;
+
+    int listener = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in address{};
+    address.sin_family = AF_INET;
+    address.sin_addr.s_addr = htonl(INADDR_ANY);
+    address.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(listener, reinterpret_cast<sockaddr*>(&address),
+             sizeof address) != 0) {
+        perror("bind");
+        return 1;
+    }
+    socklen_t length = sizeof address;
+    getsockname(listener, reinterpret_cast<sockaddr*>(&address), &length);
+    if (listen(listener, 64) != 0) {
+        perror("listen");
+        return 1;
+    }
+    printf("LISTENING %d\n", ntohs(address.sin_port));
+    fflush(stdout);
+
+    for (;;) {
+        std::vector<pollfd> fds;
+        fds.push_back({listener, POLLIN, 0});
+        for (auto& [fd, client] : clients)
+            fds.push_back({fd, static_cast<short>(
+                POLLIN | (client.outbuf.empty() ? 0 : POLLOUT)), 0});
+        if (poll(fds.data(), fds.size(), -1) < 0) {
+            if (errno == EINTR) continue;
+            perror("poll");
+            return 1;
+        }
+        if (fds[0].revents & POLLIN) {
+            int fd = accept(listener, nullptr, nullptr);
+            if (fd >= 0) {
+                setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+                clients[fd].fd = fd;
+            }
+        }
+        for (size_t i = 1; i < fds.size(); ++i) {
+            int fd = fds[i].fd;
+            auto it = clients.find(fd);
+            if (it == clients.end()) continue;
+            Client& client = it->second;
+            if (fds[i].revents & (POLLERR | POLLHUP)) {
+                drop_client(fd, true);
+                continue;
+            }
+            if (fds[i].revents & POLLIN) {
+                char buffer[65536];
+                ssize_t got = recv(fd, buffer, sizeof buffer, 0);
+                if (got <= 0) {
+                    drop_client(fd, true);
+                    continue;
+                }
+                client.inbuf.append(buffer, static_cast<size_t>(got));
+                if (!process_input(client)) {
+                    // DISCONNECT (will already cleared) or protocol
+                    // error (will fires).
+                    drop_client(fd, client.has_will);
+                    continue;
+                }
+            }
+            if ((fds[i].revents & POLLOUT) && !client.outbuf.empty()) {
+                ssize_t sent = send(fd, client.outbuf.data(),
+                                    client.outbuf.size(), 0);
+                if (sent < 0) {
+                    drop_client(fd, true);
+                    continue;
+                }
+                client.outbuf.erase(0, static_cast<size_t>(sent));
+            }
+        }
+    }
+}

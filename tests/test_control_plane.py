@@ -148,3 +148,30 @@ def test_share_query_to_registrar(runtime):
                      lambda: any("sync" in p for p in got))
     adds = [p for p in got if p.startswith("(add")]
     assert len(adds) == 1 and "query_a" in adds[0]
+
+
+def test_stale_primary_record_is_cleared_and_superseded(runtime):
+    """A retained (primary found) left by a registrar that died without
+    its will firing must not pin later registrars in secondary: the
+    probe detects the dead primary, clears the stale record, and the
+    live registrar promotes itself (the condition the reference clears
+    manually via system_reset.sh)."""
+    from aiko_services_tpu.services import Registrar
+    from aiko_services_tpu.utils import generate
+
+    # Fabricate the stale record: a plausible but dead topic path.
+    runtime.message.publish(
+        runtime.topic_registrar_boot,
+        generate("primary",
+                 ["found", f"{runtime.namespace}/deadhost/1/0", "v0",
+                  1.0]),
+        retain=True)
+
+    registrar = Registrar(runtime=runtime, primary_search_timeout=0.05)
+    registrar._probe_interval = 0.1          # fast probe for the test
+    assert run_until(runtime, lambda: registrar.state == "secondary",
+                     timeout=5.0)
+    # Probe goes unanswered twice -> stale record cleared -> promotion.
+    assert run_until(runtime, lambda: registrar.state == "primary",
+                     timeout=10.0), "stale primary never superseded"
+    assert registrar._probe_timer is None

@@ -1,0 +1,230 @@
+"""Real-MQTT integration: the in-tree C++ broker (native/
+mqtt_broker.cpp) + the stdlib MQTT client (transport/mini_mqtt.py)
+carrying the genuine control plane -- registrar election, discovery,
+actor RPC, EC share replication, and LWT failure detection over real
+TCP sockets (the role mosquitto plays for the reference,
+scripts/system_start.sh:28-56)."""
+
+import time
+
+import pytest
+
+from conftest import run_until
+from aiko_services_tpu.transport import BrokerProcess
+from aiko_services_tpu.transport.mini_mqtt import Client
+
+
+@pytest.fixture(scope="module")
+def broker():
+    with BrokerProcess(export_env=False) as instance:
+        yield instance
+
+
+@pytest.fixture
+def mqtt_runtime(broker, monkeypatch):
+    """Process runtime on the real MQTT transport against the native
+    broker."""
+    monkeypatch.setenv("AIKO_MQTT_HOST", "127.0.0.1")
+    monkeypatch.setenv("AIKO_MQTT_PORT", str(broker.port))
+    monkeypatch.delenv("AIKO_MQTT_HOSTS", raising=False)
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.services.share import reset_services_cache
+
+    reset_services_cache()
+    runtime = init_process(transport="mqtt")
+    runtime.initialize()
+    yield runtime
+    runtime.engine.terminate()
+    runtime.message.disconnect()
+    reset_process()
+
+
+# -- raw client <-> broker --------------------------------------------------
+
+def connect_client(broker, on_message=None, will=None):
+    client = Client()
+    events = {"connected": False}
+
+    def on_connect(*args):
+        events["connected"] = True
+
+    client.on_connect = on_connect
+    if on_message is not None:
+        client.on_message = on_message
+    if will is not None:
+        client.will_set(*will)
+    client.connect_async("127.0.0.1", broker.port)
+    client.loop_start()
+    deadline = time.time() + 5.0
+    while not events["connected"] and time.time() < deadline:
+        time.sleep(0.01)
+    assert events["connected"], "client never connected"
+    return client
+
+
+def wait_for(predicate, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_publish_subscribe_wildcards(broker):
+    got = []
+    subscriber = connect_client(
+        broker, on_message=lambda c, u, m: got.append(
+            (m.topic, m.payload.decode())))
+    publisher = connect_client(broker)
+    subscriber.subscribe("ns/+/state")
+    subscriber.subscribe("deep/#")
+    time.sleep(0.1)                               # SUBACK round trip
+    publisher.publish("ns/a/state", "alpha")
+    publisher.publish("ns/a/b/state", "too-deep")
+    publisher.publish("deep/x/y/z", "beta")
+    assert wait_for(lambda: len(got) >= 2)
+    assert ("ns/a/state", "alpha") in got
+    assert ("deep/x/y/z", "beta") in got
+    assert all(topic != "ns/a/b/state" for topic, _ in got)
+    subscriber.disconnect(), publisher.disconnect()
+    subscriber.loop_stop(), publisher.loop_stop()
+
+
+def test_retained_message_and_clear(broker):
+    publisher = connect_client(broker)
+    publisher.publish("boot/primary", "found", retain=True)
+    time.sleep(0.1)
+    got = []
+    late = connect_client(
+        broker, on_message=lambda c, u, m: got.append(m.payload.decode()))
+    late.subscribe("boot/#")
+    assert wait_for(lambda: "found" in got)       # retained delivery
+
+    publisher.publish("boot/primary", "", retain=True)   # clear
+    time.sleep(0.1)
+    got2 = []
+    later = connect_client(
+        broker, on_message=lambda c, u, m: got2.append(m.payload))
+    later.subscribe("boot/#")
+    time.sleep(0.3)
+    assert got2 == []                             # nothing retained
+    for client in (publisher, late, later):
+        client.disconnect()
+        client.loop_stop()
+
+
+def test_last_will_fires_on_abnormal_disconnect(broker):
+    import socket
+    import struct
+
+    got = []
+    watcher = connect_client(
+        broker, on_message=lambda c, u, m: got.append(
+            (m.topic, m.payload.decode())))
+    watcher.subscribe("ns/+/0/state")
+    time.sleep(0.1)
+
+    # Hand-rolled CONNECT with a will, then a hard socket close with no
+    # DISCONNECT -- the process-died case LWT exists for.
+    def mqtt_string(text):
+        return struct.pack(">H", len(text)) + text.encode()
+
+    payload = (mqtt_string("doomed") + mqtt_string("ns/h1/0/state")
+               + mqtt_string("(absent)"))
+    body = (mqtt_string("MQTT") + bytes([4, 0x02 | 0x04 | 0x20])
+            + struct.pack(">H", 60) + payload)
+    doomed = socket.create_connection(("127.0.0.1", broker.port))
+    doomed.sendall(bytes([0x10, len(body)]) + body)
+    assert doomed.recv(4)[:2] == b"\x20\x02"      # CONNACK
+    doomed.close()                                # abrupt
+    assert wait_for(lambda: ("ns/h1/0/state", "(absent)") in got)
+    watcher.disconnect()
+    watcher.loop_stop()
+
+
+# -- full control plane over real MQTT --------------------------------------
+
+def test_control_plane_over_native_broker(mqtt_runtime):
+    """Registrar election, actor discovery/RPC, and EC share
+    replication run unchanged over the native broker."""
+    from aiko_services_tpu.services import (Actor, Registrar,
+                                            ServiceFilter, do_command)
+
+    runtime = mqtt_runtime
+    Registrar(runtime=runtime, primary_search_timeout=0.2)
+
+    class Greeter(Actor):
+        def __init__(self, runtime=None):
+            super().__init__("greeter", "greeter:0", runtime=runtime)
+            self.greeted = []
+            self.share["mood"] = "calm"
+
+        def greet(self, name):
+            self.greeted.append(str(name))
+            self.ec_producer.update("mood", "happy")
+
+    greeter = Greeter(runtime=runtime)
+    done = []
+    do_command(runtime, None, ServiceFilter(protocol="greeter"),
+               lambda proxy: (proxy.greet("Pele"), done.append(1)))
+    assert run_until(runtime, lambda: greeter.greeted == ["Pele"],
+                     timeout=15.0), "RPC over MQTT never arrived"
+
+    # EC share: a consumer on the same fabric mirrors the update.
+    from aiko_services_tpu.services import ECConsumer
+    view = {}
+    ECConsumer(runtime, greeter.topic_path, view)
+    assert run_until(runtime, lambda: view.get("mood") == "happy",
+                     timeout=15.0), "EC share never replicated"
+
+
+def test_two_processes_over_native_broker(broker, monkeypatch):
+    """The real multi-host shape: a Registrar in a SEPARATE OS process
+    (via the CLI), discovered and used by this process over the broker
+    (reference: aiko_registrar + any client host, joined by mosquitto)."""
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    monkeypatch.setenv("AIKO_MQTT_HOST", "127.0.0.1")
+    monkeypatch.setenv("AIKO_MQTT_PORT", str(broker.port))
+    monkeypatch.delenv("AIKO_MQTT_HOSTS", raising=False)
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    registrar_process = subprocess.Popen(
+        [sys.executable, "-m", "aiko_services_tpu", "registrar",
+         "-t", "mqtt"],
+        cwd=repo,
+        env={"PATH": "/usr/bin:/bin", "HOME": "/tmp",
+             "AIKO_LOG_LEVEL": "ERROR",
+             "AIKO_MQTT_HOST": "127.0.0.1",
+             "AIKO_MQTT_PORT": str(broker.port),
+             "PYTHONPATH": str(repo)})
+    try:
+        from aiko_services_tpu.runtime import init_process, reset_process
+        from aiko_services_tpu.services import Actor
+        from aiko_services_tpu.services.share import reset_services_cache
+        from aiko_services_tpu.services.share import \
+            services_cache_singleton
+
+        reset_services_cache()
+        runtime = init_process(transport="mqtt")
+        runtime.initialize()
+        try:
+            actor = Actor("cross_proc", "cross:0", runtime=runtime)
+            cache = services_cache_singleton(runtime)
+            # The remote registrar must answer the share query and list
+            # our local actor back to us.
+            assert run_until(
+                runtime,
+                lambda: any(r.name == "cross_proc"
+                            for r in cache.registry.all()),
+                timeout=20.0), "remote registrar never mirrored us"
+        finally:
+            runtime.engine.terminate()
+            runtime.message.disconnect()
+            reset_process()
+    finally:
+        registrar_process.terminate()
+        registrar_process.wait(timeout=5.0)
