@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.layers import (rms_norm, rope_frequencies, apply_rope, swiglu,
-                          repeat_kv, attention_prefill, attention_decode)
+                          attention_prefill, attention_decode)
 from ..parallel.mesh import P
 
 __all__ = ["LlamaConfig", "init_params", "partition_specs",
@@ -213,9 +213,9 @@ def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
             k_layer2 = k_layer.at[batch_index, positions].set(k)
             v_layer2 = v_layer.at[batch_index, positions].set(v)
             kv_write.updated = (k_layer2, v_layer2)
-            k_all = repeat_kv(k_layer2, c.gqa_groups)
-            v_all = repeat_kv(v_layer2, c.gqa_groups)
-            return attention_prefill(q, k_all, v_all, positions)
+            # Grouped cache consumed directly (attention_prefill groups
+            # the queries): no repeat_kv materialization.
+            return attention_prefill(q, k_layer2, v_layer2, positions)
         return kv_write
 
     return _forward_layers(params, c, params["embed"][tokens], cache,
@@ -255,9 +255,7 @@ def prefill_into_slot(params: dict, config: LlamaConfig,
                 k_layer2, (slot, 0, 0, 0), (1,) + k_layer.shape[1:])
             v_row = jax.lax.dynamic_slice(
                 v_layer2, (slot, 0, 0, 0), (1,) + v_layer.shape[1:])
-            k_all = repeat_kv(k_row, c.gqa_groups)
-            v_all = repeat_kv(v_row, c.gqa_groups)
-            return attention_prefill(q, k_all, v_all, positions)
+            return attention_prefill(q, k_row, v_row, positions)
         return kv_write
 
     return _forward_layers(params, c, params["embed"][tokens], cache,
@@ -286,9 +284,7 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
             k_layer2 = k_layer.at[batch_index, lengths].set(k[:, 0])
             v_layer2 = v_layer.at[batch_index, lengths].set(v[:, 0])
             kv_write.updated = (k_layer2, v_layer2)
-            k_all = repeat_kv(k_layer2, c.gqa_groups)
-            v_all = repeat_kv(v_layer2, c.gqa_groups)
-            return attention_decode(q, k_all, v_all, lengths + 1)
+            return attention_decode(q, k_layer2, v_layer2, lengths + 1)
         return kv_write
 
     logits, new_cache = _forward_layers(

@@ -64,46 +64,61 @@ def repeat_kv(kv: jax.Array, repeats: int) -> jax.Array:
                                                            k * repeats, d)
 
 
+def _group_queries(q: jax.Array, kv_heads: int):
+    """[B, S, H, hd] -> [B, S, K, G, hd] with H = K*G (GQA grouping)."""
+    b, s, h, d = q.shape
+    return q.reshape(b, s, kv_heads, h // kv_heads, d)
+
+
 def attention_prefill(q: jax.Array, k: jax.Array, v: jax.Array,
                       q_positions: jax.Array,
                       kv_length_mask: jax.Array | None = None) -> jax.Array:
     """Causal attention for a prompt chunk.
 
-    q: [B, S, H, hd]; k/v: [B, T, H, hd] (already GQA-expanded);
-    q_positions: [B, S] absolute positions of the queries (so chunked
-    prefill against a longer cache works); kv_length_mask: [B, T] bool of
-    valid cache slots.  float32 softmax.
+    q: [B, S, H, hd]; k/v: [B, T, K, hd] where K divides H -- grouped
+    (GQA) caches are consumed directly, queries grouped onto the kv
+    heads, so the expanded [B, T, H, hd] cache is never materialized
+    (at llama3-1b decode that materialization alone is ~4x the whole
+    cache's HBM traffic per step); q_positions: [B, S] absolute
+    positions of the queries (so chunked prefill against a longer cache
+    works); kv_length_mask: [B, T] bool of valid cache slots.  float32
+    softmax.
     """
     scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bshd,bthd->bhst", q, k,
+    grouped = _group_queries(q, k.shape[2])        # [B,S,K,G,hd]
+    logits = jnp.einsum("bskgd,btkd->bkgst", grouped, k,
                         preferred_element_type=jnp.float32) * scale
     t = k.shape[1]
-    kv_positions = jnp.arange(t)[None, None, None, :]       # [1,1,1,T]
-    causal = kv_positions <= q_positions[:, None, :, None]   # [B,1,S,T]
+    kv_positions = jnp.arange(t)[None, None, None, None, :]  # [1,1,1,1,T]
+    causal = kv_positions <= \
+        q_positions[:, None, None, :, None]        # [B,1,1,S,T]
     if kv_length_mask is not None:
-        causal = jnp.logical_and(causal,
-                                 kv_length_mask[:, None, None, :])
+        causal = jnp.logical_and(
+            causal, kv_length_mask[:, None, None, None, :])
     logits = jnp.where(causal, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhst,bthd->bshd",
-                      weights.astype(v.dtype), v)
+    out = jnp.einsum("bkgst,btkd->bskgd", weights.astype(v.dtype), v)
+    return out.reshape(q.shape)
 
 
 def attention_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      lengths: jax.Array) -> jax.Array:
     """Single-token decode against the cache.
 
-    q: [B, 1, H, hd]; k_cache/v_cache: [B, T, H, hd] (GQA-expanded);
-    lengths: [B] number of valid positions (including the token just
-    written).  Returns [B, 1, H, hd].
+    q: [B, 1, H, hd]; k_cache/v_cache: [B, T, K, hd] where K divides H
+    (grouped caches consumed directly, see attention_prefill); lengths:
+    [B] number of valid positions (including the token just written).
+    Returns [B, 1, H, hd].
     """
     scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bshd,bthd->bhst", q, k_cache,
+    grouped = _group_queries(q, k_cache.shape[2])  # [B,1,K,G,hd]
+    logits = jnp.einsum("bskgd,btkd->bkgst", grouped, k_cache,
                         preferred_element_type=jnp.float32) * scale
     t = k_cache.shape[1]
-    valid = jnp.arange(t)[None, None, None, :] < \
-        lengths[:, None, None, None]
+    valid = jnp.arange(t)[None, None, None, None, :] < \
+        lengths[:, None, None, None, None]
     logits = jnp.where(valid, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhst,bthd->bshd",
-                      weights.astype(v_cache.dtype), v_cache)
+    out = jnp.einsum("bkgst,btkd->bskgd",
+                     weights.astype(v_cache.dtype), v_cache)
+    return out.reshape(q.shape)

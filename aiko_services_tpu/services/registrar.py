@@ -142,6 +142,7 @@ class Registrar(Actor):
                         "registrar conflict: demoting %s in favor of %s",
                         self.topic_path, other_topic)
                     self._demote()
+                    self._watch_primary(other_topic)
                 else:
                     # I win: re-assert my retained record so the loser
                     # (whose record just overwrote mine) sees it, demotes,

@@ -198,6 +198,11 @@ class Client:
                 delay = 0.25              # healthy session completed
             except OSError as error:
                 _logger.debug("mqtt connect/read error: %s", error)
+            except Exception:
+                # A malformed packet (struct.error etc.) must reconnect
+                # like a socket error, not silently kill this thread
+                # while the transport still reports CONNECTED.
+                _logger.exception("mqtt session error; reconnecting")
             if self.on_disconnect is not None:
                 try:
                     self.on_disconnect(self, None)

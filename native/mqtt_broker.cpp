@@ -29,6 +29,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <ctime>
 #include <map>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -55,6 +56,8 @@ struct Client {
     bool has_will = false;
     std::string will_topic, will_payload;
     bool will_retain = false;
+    uint16_t keepalive = 0;       // seconds; 0 = no timeout
+    time_t last_activity = 0;
 };
 
 std::map<int, Client> clients;                     // fd -> client
@@ -168,7 +171,7 @@ bool handle_packet(Client& client, uint8_t header,
         if (at + 4 > body.size()) return false;
         at += 1;                                   // protocol level
         uint8_t flags = static_cast<uint8_t>(body[at]); at += 1;
-        at += 2;                                   // keepalive
+        client.keepalive = read_u16(body, at); at += 2;
         if (at + 2 > body.size()) return false;
         size_t id_length = read_u16(body, at); at += 2;
         if (at + id_length > body.size()) return false;
@@ -328,7 +331,7 @@ int main(int argc, char** argv) {
         for (auto& [fd, client] : clients)
             fds.push_back({fd, static_cast<short>(
                 POLLIN | (client.outbuf.empty() ? 0 : POLLOUT)), 0});
-        if (poll(fds.data(), fds.size(), -1) < 0) {
+        if (poll(fds.data(), fds.size(), 1000) < 0) {
             if (errno == EINTR) continue;
             perror("poll");
             return 1;
@@ -338,6 +341,7 @@ int main(int argc, char** argv) {
             if (fd >= 0) {
                 setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
                 clients[fd].fd = fd;
+                clients[fd].last_activity = time(nullptr);
             }
         }
         for (size_t i = 1; i < fds.size(); ++i) {
@@ -345,10 +349,9 @@ int main(int argc, char** argv) {
             auto it = clients.find(fd);
             if (it == clients.end()) continue;
             Client& client = it->second;
-            if (fds[i].revents & (POLLERR | POLLHUP)) {
-                drop_client(fd, true);
-                continue;
-            }
+            // Drain input BEFORE acting on POLLHUP: a DISCONNECT sent
+            // just before the peer closed arrives as POLLIN|POLLHUP
+            // and must still clear the will (MQTT-3.14.4-3).
             if (fds[i].revents & POLLIN) {
                 char buffer[65536];
                 ssize_t got = recv(fd, buffer, sizeof buffer, 0);
@@ -356,6 +359,7 @@ int main(int argc, char** argv) {
                     drop_client(fd, true);
                     continue;
                 }
+                client.last_activity = time(nullptr);
                 client.inbuf.append(buffer, static_cast<size_t>(got));
                 if (!process_input(client)) {
                     // DISCONNECT (will already cleared) or protocol
@@ -363,6 +367,9 @@ int main(int argc, char** argv) {
                     drop_client(fd, client.has_will);
                     continue;
                 }
+            } else if (fds[i].revents & (POLLERR | POLLHUP)) {
+                drop_client(fd, true);
+                continue;
             }
             if ((fds[i].revents & POLLOUT) && !client.outbuf.empty()) {
                 ssize_t sent = send(fd, client.outbuf.data(),
@@ -374,5 +381,18 @@ int main(int argc, char** argv) {
                 client.outbuf.erase(0, static_cast<size_t>(sent));
             }
         }
+        // Keepalive enforcement (mosquitto semantics): no traffic for
+        // 1.5x the client's keepalive -> dead host, will fires.  This
+        // is the liveness signal multi-host failure detection rides on
+        // when a peer loses power (no FIN/RST ever arrives).
+        time_t now = time(nullptr);
+        std::vector<int> timed_out;
+        for (auto& [fd, client] : clients)
+            if (client.keepalive > 0
+                    && now - client.last_activity
+                       > static_cast<time_t>(client.keepalive * 3 / 2))
+                timed_out.push_back(fd);
+        for (int fd : timed_out)
+            drop_client(fd, true);
     }
 }

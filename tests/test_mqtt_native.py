@@ -228,3 +228,59 @@ def test_two_processes_over_native_broker(broker, monkeypatch):
     finally:
         registrar_process.terminate()
         registrar_process.wait(timeout=5.0)
+
+
+def _raw_connect(broker, client_id, will_topic, keepalive=60):
+    """Hand-rolled CONNECT with a will; returns the connected socket."""
+    import socket
+    import struct
+
+    def mqtt_string(text):
+        return struct.pack(">H", len(text)) + text.encode()
+
+    payload = (mqtt_string(client_id) + mqtt_string(will_topic)
+               + mqtt_string("(absent)"))
+    body = (mqtt_string("MQTT") + bytes([4, 0x02 | 0x04])
+            + struct.pack(">H", keepalive) + payload)
+    sock = socket.create_connection(("127.0.0.1", broker.port))
+    sock.sendall(bytes([0x10, len(body)]) + body)
+    assert sock.recv(4)[:2] == b"\x20\x02"        # CONNACK
+    return sock
+
+
+def test_graceful_disconnect_suppresses_will(broker):
+    """DISCONNECT followed immediately by close (they arrive as one
+    POLLIN|POLLHUP burst) must clear the will (MQTT-3.14.4-3) -- a live
+    process cycling its connection to change its will must not be
+    declared dead."""
+    got = []
+    watcher = connect_client(
+        broker, on_message=lambda c, u, m: got.append(m.topic))
+    watcher.subscribe("grace/+/state")
+    time.sleep(0.1)
+    polite = _raw_connect(broker, "polite", "grace/p1/state")
+    polite.sendall(bytes([0xe0, 0]))              # DISCONNECT
+    polite.close()                                # immediately
+    time.sleep(0.5)
+    assert got == [], "will fired on a graceful disconnect"
+    watcher.disconnect()
+    watcher.loop_stop()
+
+
+def test_keepalive_timeout_fires_will(broker):
+    """A silently-dead client (no FIN -- e.g. host power loss) is
+    detected at 1.5x keepalive and its will fires (mosquitto
+    semantics)."""
+    got = []
+    watcher = connect_client(
+        broker, on_message=lambda c, u, m: got.append(m.topic))
+    watcher.subscribe("silent/+/state")
+    time.sleep(0.1)
+    quiet = _raw_connect(broker, "quiet", "silent/h2/state", keepalive=1)
+    # Send nothing and keep the socket open: only the keepalive timer
+    # can detect this death.
+    assert wait_for(lambda: "silent/h2/state" in got, timeout=10.0), \
+        "keepalive expiry never fired the will"
+    quiet.close()
+    watcher.disconnect()
+    watcher.loop_stop()
