@@ -34,6 +34,25 @@ _transport_option = click.option(
     "--transport", "-t", default=None,
     help="message fabric: mqtt | loopback (default: $AIKO_TRANSPORT)")
 
+_HOOK_ALIASES = {"pf": "pipeline.process_frame:0",
+                 "pe": "pipeline.process_element:0",
+                 "pep": "pipeline.process_element_post:0",
+                 "rp": "pipeline.replacement:0"}
+
+
+def _parse_hooks_spec(hooks_spec: str | None) -> list[str]:
+    if not hooks_spec:
+        return []
+    wanted = {part.strip() for part in hooks_spec.split(",")}
+    unknown = wanted - set(_HOOK_ALIASES) - {"all"}
+    if unknown:
+        raise click.BadParameter(
+            f"unknown hooks {sorted(unknown)}; "
+            f"choose from {sorted(_HOOK_ALIASES)} or 'all'")
+    if "all" in wanted:
+        return list(_HOOK_ALIASES.values())
+    return [_HOOK_ALIASES[part] for part in wanted]
+
 
 @click.group()
 def main():
@@ -101,15 +120,26 @@ def pipeline():
 @click.option("--profile", "profile_dir", default=None,
               help="write a jax.profiler trace (TensorBoard/xprof) to DIR "
                    "with per-element TraceAnnotations while running")
+@click.option("--hooks", "hooks_spec", default=None,
+              help="attach the default printing handler to hooks: "
+                   "comma list of pf,pe,pep,rp,all (reference "
+                   "pipeline.py:1613-1625)")
 def pipeline_create(definition_pathname, transport, name, stream_id,
-                    frame_data, parameters, frame_rate, profile_dir):
+                    frame_data, parameters, frame_rate, profile_dir,
+                    hooks_spec):
     """Create a Pipeline from DEFINITION_PATHNAME (JSON) and run it."""
     from .pipeline import create_pipeline
     from .utils import parse_value
 
+    hook_names = _parse_hooks_spec(hooks_spec)   # fail before building
     runtime = _runtime(transport)
     instance = create_pipeline(definition_pathname, name=name,
                                runtime=runtime)
+    if hook_names:
+        from .runtime.hooks import default_hook_handler
+
+        for hook_name in hook_names:
+            instance.add_hook_handler(hook_name, default_hook_handler)
     profiler = None
     if profile_dir:
         from .tpu import Profiler
@@ -164,31 +194,79 @@ def pipeline_list(transport, timeout):
     click.echo(f"{len(records)} pipeline(s)")
 
 
-@pipeline.command("destroy")
-@click.argument("name")
-@_transport_option
-@click.option("--timeout", default=3.0, help="discovery wait seconds")
-def pipeline_destroy(name, transport, timeout):
-    """Ask the named pipeline process to stop."""
+def _with_named_pipeline(name, transport, timeout, action, verb):
+    """Discover ONE pipeline by name and run ``action(proxy)`` against
+    it (shared by destroy/update; the next named-pipeline command should
+    use this too)."""
     from .pipeline import PROTOCOL_PIPELINE
     from .services import ServiceFilter, do_command
 
     runtime = _runtime(transport)
     done = []
 
-    def send_stop(proxy):
-        proxy.stop()
+    def run_action(proxy):
+        action(runtime, proxy)
         done.append(proxy.topic_path)
 
     do_command(runtime, None,
                ServiceFilter(name=name, protocol=PROTOCOL_PIPELINE),
-               send_stop)
+               run_action)
     runtime.run(until=lambda: bool(done), timeout=timeout)
     if done:
-        click.echo(f"stop sent to {done[0]}")
+        click.echo(f"{verb} sent to {done[0]}")
     else:
         click.echo(f"pipeline {name!r} not found", err=True)
         sys.exit(1)
+
+
+@pipeline.command("destroy")
+@click.argument("name")
+@_transport_option
+@click.option("--timeout", default=3.0, help="discovery wait seconds")
+def pipeline_destroy(name, transport, timeout):
+    """Ask the named pipeline process to stop."""
+    _with_named_pipeline(name, transport, timeout,
+                         lambda runtime, proxy: proxy.stop(), "stop")
+
+
+@pipeline.command("update")
+@click.argument("name")
+@_transport_option
+@click.option("--parameter", "-p", "parameters", nargs=2, multiple=True,
+              help="update a live parameter NAME VALUE (repeatable); "
+                   "qualified 'Element.param' targets that element")
+@click.option("--stream-id", "-s", default=None,
+              help="stream id for --frame-data (created on demand)")
+@click.option("--frame-data", "-fd", default=None,
+              help="inject a frame, e.g. '(x: 1)'")
+@click.option("--timeout", default=3.0, help="discovery wait seconds")
+def pipeline_update(name, transport, parameters, stream_id, frame_data,
+                    timeout):
+    """Live-update a running pipeline found by NAME: set parameters
+    (``set_parameter`` routes qualified names to the element) and/or
+    inject a frame (reference ``aiko_pipeline update``,
+    pipeline.py:1982-2034)."""
+    from .utils import generate, generate_value, parse_value
+
+    if frame_data is not None:
+        data = parse_value(frame_data)
+        if not isinstance(data, dict):
+            raise click.BadParameter(
+                "frame data must be an S-expression dictionary, "
+                "e.g. '(x: 1)'")
+
+    def send_update(runtime, proxy):
+        publish = runtime.message.publish
+        for key, value in parameters:
+            publish(f"{proxy.topic_path}/in",
+                    generate("set_parameter", [key, value]))
+        if frame_data is not None:
+            stream = {"stream_id": stream_id or "1"}
+            publish(f"{proxy.topic_path}/in",
+                    f"(process_frame {generate_value(stream)} "
+                    f"{frame_data})")
+
+    _with_named_pipeline(name, transport, timeout, send_update, "update")
 
 
 @pipeline.command("validate")

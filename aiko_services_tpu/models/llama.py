@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.layers import (rms_norm, rope_frequencies, apply_rope, swiglu,
-                          attention_prefill, attention_decode)
+                          attention_prefill, attention_decode_append)
 from ..parallel.mesh import P
 
 __all__ = ["LlamaConfig", "init_params", "partition_specs",
@@ -164,12 +164,18 @@ def _block(config: LlamaConfig, hidden, layer, kv_write):
 
 
 def _forward_layers(params: dict, config: LlamaConfig, hidden,
-                    cache: dict, kv_write_factory):
+                    cache: dict, kv_write_factory,
+                    cache_from_updates=None):
     """Embed-to-logits scaffolding shared by the prefill/decode variants:
     scan the stacked layers, final-norm, unembed.
 
     ``kv_write_factory(k_layer, v_layer) -> kv_write`` builds the
-    per-layer cache-write-and-attend closure (see :func:`_block`).
+    per-layer cache-write-and-attend closure (see :func:`_block`); each
+    layer's ``kv_write.updated`` is stacked as the scan output.  By
+    default those updates ARE the new cache layers (prefill writes
+    in-scan); ``cache_from_updates`` post-processes them instead -- the
+    decode path emits only each layer's new-token k/v (so the scan never
+    rewrites the whole cache) and scatters once at the end.
     Activation sharding follows from the param/cache input shardings via
     SPMD propagation; serving/training wrappers pin in_shardings
     explicitly (see models/train.py, tpu elements).
@@ -180,11 +186,14 @@ def _forward_layers(params: dict, config: LlamaConfig, hidden,
         hidden2 = _block(config, hidden, layer, kv_write)
         return hidden2, kv_write.updated
 
-    hidden, (k_new, v_new) = jax.lax.scan(
+    hidden, updates = jax.lax.scan(
         layer_step, hidden,
         (params["layers"], cache["k"], cache["v"]))
     hidden = rms_norm(hidden, params["final_norm"], config.norm_eps)
     logits = hidden @ params["unembed"]
+    if cache_from_updates is not None:
+        return logits, cache_from_updates(updates)
+    k_new, v_new = updates
     return logits, {"k": k_new, "v": v_new}
 
 
@@ -280,15 +289,25 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
         def kv_write(q, k, v):
             q = apply_rope(q, rope_table, positions)
             k = apply_rope(k, rope_table, positions)
-            batch_index = jnp.arange(b)
-            k_layer2 = k_layer.at[batch_index, lengths].set(k[:, 0])
-            v_layer2 = v_layer.at[batch_index, lengths].set(v[:, 0])
-            kv_write.updated = (k_layer2, v_layer2)
-            return attention_decode(q, k_layer2, v_layer2, lengths + 1)
+            # The cache stays a read-only scan input; only the token's
+            # k/v leave the scan (see _forward_layers / the post-scan
+            # scatter below).
+            kv_write.updated = (k, v)
+            return attention_decode_append(q, k_layer, v_layer, k, v,
+                                           lengths)
         return kv_write
 
+    def scatter_tokens(updates):
+        k_tokens, v_tokens = updates               # [L, B, 1, K, hd]
+        batch_index = jnp.arange(b)
+        return {"k": cache["k"].at[:, batch_index, lengths].set(
+                    k_tokens[:, :, 0]),
+                "v": cache["v"].at[:, batch_index, lengths].set(
+                    v_tokens[:, :, 0])}
+
     logits, new_cache = _forward_layers(
-        params, c, params["embed"][tokens][:, None, :], cache, factory)
+        params, c, params["embed"][tokens][:, None, :], cache, factory,
+        cache_from_updates=scatter_tokens)
     return logits[:, 0, :], new_cache
 
 

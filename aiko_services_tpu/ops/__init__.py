@@ -1,2 +1,3 @@
 from .layers import (rms_norm, rope_frequencies, apply_rope, swiglu,
-                     repeat_kv, attention_prefill, attention_decode)
+                     repeat_kv, attention_prefill, attention_decode,
+                     attention_decode_append)

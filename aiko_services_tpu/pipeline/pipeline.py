@@ -248,6 +248,23 @@ class Pipeline(Actor):
     def set_pipeline_parameter(self, name: str, value):
         self._pipeline_parameters[name] = value
 
+    def set_parameter(self, name=None, value=None):
+        """Wire command ``(set_parameter name value)`` -- live parameter
+        update (reference pipeline.py:1585-1603).  Qualified
+        ``Element.param`` targets that element's own parameters (the
+        first thing ``get_parameter`` consults after stream params);
+        bare names become pipeline-level parameters visible to every
+        element."""
+        if name is None:
+            return
+        name = str(name)
+        element_name, _, bare = name.partition(".")
+        if bare and element_name in self.graph:
+            self.graph.get_node(element_name).element.set_parameter(
+                bare, value)
+        else:
+            self.set_pipeline_parameter(name, value)
+
     def current_stream(self) -> Stream | None:
         return self._current_stream_ref
 

@@ -284,3 +284,68 @@ def test_keepalive_timeout_fires_will(broker):
     quiet.close()
     watcher.disconnect()
     watcher.loop_stop()
+
+
+def test_pipeline_update_cli_over_broker(broker):
+    """`pipeline update NAME -p k v -fd ...` finds a running pipeline by
+    name over the fabric and live-updates it (reference `aiko_pipeline
+    update`)."""
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = {"PATH": "/usr/bin:/bin", "HOME": "/tmp",
+           "AIKO_LOG_LEVEL": "INFO", "PYTHONPATH": str(repo),
+           "JAX_PLATFORMS": "cpu",
+           "AIKO_MQTT_HOST": "127.0.0.1",
+           "AIKO_MQTT_PORT": str(broker.port)}
+    registrar = subprocess.Popen(
+        [sys.executable, "-m", "aiko_services_tpu", "registrar",
+         "-t", "mqtt"], cwd=repo, env=env)
+    create = subprocess.Popen(
+        [sys.executable, "-m", "aiko_services_tpu", "pipeline", "create",
+         "examples/pipeline/pipeline_local.json", "-t", "mqtt"],
+        cwd=repo, env=env, stderr=subprocess.DEVNULL)
+    try:
+        update = subprocess.run(
+            [sys.executable, "-m", "aiko_services_tpu", "pipeline",
+             "update", "p_local", "-t", "mqtt", "-p", "note", "hello",
+             "-fd", "(x: 7)", "--timeout", "15"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=60)
+        assert update.returncode == 0, update.stderr[-1500:]
+        assert "update sent" in update.stdout
+    finally:
+        create.terminate()
+        create.wait(timeout=5.0)
+        registrar.terminate()
+        registrar.wait(timeout=5.0)
+
+
+def test_pipeline_create_hooks_flag(tmp_path):
+    """--hooks pf,pe attaches the printing handler; bad names rejected."""
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = {"PATH": "/usr/bin:/bin", "HOME": "/tmp",
+           "AIKO_LOG_LEVEL": "INFO", "PYTHONPATH": str(repo),
+           "JAX_PLATFORMS": "cpu"}
+    bad = subprocess.run(
+        [sys.executable, "-m", "aiko_services_tpu", "pipeline", "create",
+         "examples/pipeline/pipeline_local.json", "-t", "loopback",
+         "--hooks", "bogus"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=60)
+    assert bad.returncode != 0
+    assert "unknown hooks" in bad.stderr
+
+    good = subprocess.run(
+        ["timeout", "--signal=INT", "10", sys.executable, "-m",
+         "aiko_services_tpu", "pipeline", "create",
+         "examples/pipeline/pipeline_local.json", "-t", "loopback",
+         "-s", "1", "-fd", "(x: 1)", "--hooks", "pf,pe"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=60)
+    assert "HOOK pipeline.process_frame:0" in good.stderr
+    assert "HOOK pipeline.process_element:0" in good.stderr

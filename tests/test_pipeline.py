@@ -230,3 +230,25 @@ def test_wire_process_frame(runtime):
     run_until(runtime, lambda: bool(got), timeout=5.0)
     assert got and "process_frame_response" in got[0]
     assert "(b 6)" in got[0] or "b: 6" in got[0]
+
+
+def test_set_parameter_routing(runtime):
+    """(set_parameter ...) wire command: qualified Element.param targets
+    the element's own parameters; bare names become pipeline-level
+    (reference pipeline.py:1585-1603)."""
+    p = Pipeline(definition(
+        ["(A)"], [element("A", "ElementA", ["a"], ["a"])]),
+        runtime=runtime)
+    node = p.graph.get_node("A")
+
+    p.set_parameter("A.gain", 5)
+    assert node.element.get_parameter("gain") == (5, True)
+    assert p.get_pipeline_parameter("gain") is None   # element-scoped
+
+    p.set_parameter("threshold", 0.5)
+    assert node.element.get_parameter("threshold") == (0.5, True)
+    assert p.get_pipeline_parameter("threshold") == 0.5
+
+    # Unknown element prefix falls through to a pipeline parameter.
+    p.set_parameter("NoSuch.param", 1)
+    assert p.get_pipeline_parameter("NoSuch.param") == 1
