@@ -14,6 +14,7 @@ No pip entry points are assumed; everything runs via::
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 import click
@@ -246,8 +247,11 @@ def pipeline_update(name, transport, parameters, stream_id, frame_data,
     (``set_parameter`` routes qualified names to the element) and/or
     inject a frame (reference ``aiko_pipeline update``,
     pipeline.py:1982-2034)."""
-    from .utils import generate, generate_value, parse_value
+    from .utils import parse_value
 
+    if not parameters and frame_data is None:
+        raise click.UsageError("nothing to update: pass -p and/or -fd")
+    data = None
     if frame_data is not None:
         data = parse_value(frame_data)
         if not isinstance(data, dict):
@@ -256,15 +260,13 @@ def pipeline_update(name, transport, parameters, stream_id, frame_data,
                 "e.g. '(x: 1)'")
 
     def send_update(runtime, proxy):
-        publish = runtime.message.publish
+        # RemoteProxy encodes the wire format; these become
+        # "(set_parameter k v)" / "(process_frame (stream_id: ..) ..)"
+        # on the pipeline's in-topic.
         for key, value in parameters:
-            publish(f"{proxy.topic_path}/in",
-                    generate("set_parameter", [key, value]))
-        if frame_data is not None:
-            stream = {"stream_id": stream_id or "1"}
-            publish(f"{proxy.topic_path}/in",
-                    f"(process_frame {generate_value(stream)} "
-                    f"{frame_data})")
+            proxy.set_parameter(key, value)
+        if data is not None:
+            proxy.process_frame({"stream_id": stream_id or "1"}, data)
 
     _with_named_pipeline(name, transport, timeout, send_update, "update")
 
@@ -336,6 +338,142 @@ def broker(port):
         pass
     finally:
         instance.stop()
+
+
+# -- system lifecycle -------------------------------------------------------
+# The reference manages its fabric with shell scripts
+# (scripts/system_start.sh / system_stop.sh / system_reset.sh); with the
+# broker in-tree this is a CLI: start/stop/status/reset.
+
+def _system_state_path():
+    import pathlib
+    import tempfile
+
+    base = os.environ.get("AIKO_STATE_DIR") or tempfile.gettempdir()
+    return pathlib.Path(base) / "aiko_tpu_system.json"
+
+
+@main.group()
+def system():
+    """Start/stop the single-host fabric: native broker + registrar."""
+
+
+@system.command("start")
+@click.option("--port", default=1883, help="broker port (0 = assigned)")
+def system_start(port):
+    """Launch the native MQTT broker and a registrar as detached
+    background processes (reference scripts/system_start.sh)."""
+    import subprocess
+    import time
+
+    from .transport.broker import broker_binary
+
+    state_path = _system_state_path()
+    if state_path.exists():
+        raise click.ClickException(
+            f"system already started ({state_path}); "
+            "run 'system stop' first")
+    # Children are detached AND get their own output files: inheriting
+    # this CLI's stdout/stderr would keep those pipes open forever for
+    # any caller capturing them.
+    broker_log = open(state_path.with_suffix(".broker.log"), "w")
+    registrar_log = open(state_path.with_suffix(".registrar.log"), "w")
+    broker_process = subprocess.Popen(
+        [str(broker_binary()), str(port)],
+        stdout=subprocess.PIPE, stderr=broker_log, text=True,
+        start_new_session=True)
+    line = broker_process.stdout.readline().strip()
+    if not line.startswith("LISTENING "):
+        broker_process.terminate()
+        raise click.ClickException(f"broker failed: {line!r}")
+    actual_port = int(line.split()[1])
+    environment = dict(os.environ)
+    environment["AIKO_MQTT_HOST"] = "127.0.0.1"
+    environment["AIKO_MQTT_PORT"] = str(actual_port)
+    registrar_process = subprocess.Popen(
+        [sys.executable, "-m", "aiko_services_tpu", "registrar",
+         "-t", "mqtt"], env=environment, start_new_session=True,
+        stdout=registrar_log, stderr=registrar_log)
+    time.sleep(0.5)                    # catch instant-exit failures
+    if registrar_process.poll() is not None:
+        broker_process.terminate()
+        raise click.ClickException(
+            f"registrar exited rc={registrar_process.returncode}; "
+            f"see {registrar_log.name}")
+    state_path.write_text(json.dumps(
+        {"port": actual_port, "broker_pid": broker_process.pid,
+         "registrar_pid": registrar_process.pid}))
+    click.echo(f"broker :{actual_port} (pid {broker_process.pid}), "
+               f"registrar (pid {registrar_process.pid})")
+
+
+_SYSTEM_PROCESS_MARKS = {"broker_pid": "mqtt_broker",
+                         "registrar_pid": "registrar"}
+
+
+def _system_pid_matches(pid: int, mark: str) -> bool:
+    """Identity check before signalling a pidfile PID: a crash + PID
+    reuse must not let 'system stop' kill an unrelated process."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as stream:
+            return mark.encode() in stream.read()
+    except OSError:
+        return False
+
+
+@system.command("stop")
+def system_stop():
+    """Stop the processes started by 'system start'."""
+    import signal as signal_module
+
+    state_path = _system_state_path()
+    if not state_path.exists():
+        raise click.ClickException("system not started")
+    state = json.loads(state_path.read_text())
+    for key, mark in _SYSTEM_PROCESS_MARKS.items():
+        name = key.split("_")[0]
+        if not _system_pid_matches(state[key], mark):
+            click.echo(f"{name} already gone (or pid reused)", err=True)
+            continue
+        try:
+            os.kill(state[key], signal_module.SIGTERM)
+            click.echo(f"stopped {name} (pid {state[key]})")
+        except ProcessLookupError:
+            click.echo(f"{name} already gone", err=True)
+    state_path.unlink()
+
+
+@system.command("status")
+def system_status():
+    """Report fabric liveness."""
+    from .utils import mqtt_broker_reachable
+
+    state_path = _system_state_path()
+    if not state_path.exists():
+        click.echo("system: not started")
+        return
+    state = json.loads(state_path.read_text())
+    up = mqtt_broker_reachable("127.0.0.1", state["port"], timeout=1.0)
+    click.echo(f"broker :{state['port']} "
+               f"{'up' if up else 'DOWN'} (pid {state['broker_pid']})")
+    registrar_up = _system_pid_matches(
+        state["registrar_pid"], _SYSTEM_PROCESS_MARKS["registrar_pid"])
+    click.echo(f"registrar {'up' if registrar_up else 'DOWN'} "
+               f"(pid {state['registrar_pid']})")
+
+
+@system.command("reset")
+@_transport_option
+def system_reset(transport):
+    """Clear the retained registrar election record (reference
+    scripts/system_reset.sh -- needed after a broker kept state across
+    an unclean shutdown; live secondaries also self-heal via the
+    stale-primary probe)."""
+    runtime = _runtime(transport)
+    runtime.message.publish(runtime.topic_registrar_boot, "",
+                            retain=True)
+    runtime.run(until=lambda: False, timeout=0.5)
+    click.echo(f"cleared retained {runtime.topic_registrar_boot}")
 
 
 # -- dashboard --------------------------------------------------------------

@@ -308,6 +308,15 @@ def test_pipeline_update_cli_over_broker(broker):
          "examples/pipeline/pipeline_local.json", "-t", "mqtt"],
         cwd=repo, env=env, stderr=subprocess.DEVNULL)
     try:
+        # No-op update refused before any network traffic.
+        noop = subprocess.run(
+            [sys.executable, "-m", "aiko_services_tpu", "pipeline",
+             "update", "p_local", "-t", "mqtt"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=60)
+        assert noop.returncode != 0
+        assert "nothing to update" in noop.stderr
+
         update = subprocess.run(
             [sys.executable, "-m", "aiko_services_tpu", "pipeline",
              "update", "p_local", "-t", "mqtt", "-p", "note", "hello",
@@ -316,6 +325,28 @@ def test_pipeline_update_cli_over_broker(broker):
             timeout=60)
         assert update.returncode == 0, update.stderr[-1500:]
         assert "update sent" in update.stdout
+
+        # End-to-end effect check: a response-routed frame over the
+        # same wire command the CLI used executes in the remote
+        # pipeline and answers with the computed result
+        # (2x + x^2 at x=7 -> 63).  The pipeline's topic path comes
+        # from the CLI's own "update sent to <topic>" report.
+        topic_path = update.stdout.strip().rsplit(" ", 1)[-1]
+        got = []
+        observer = connect_client(
+            broker, on_message=lambda c, u, m: got.append(
+                m.payload.decode()))
+        response_topic = "test/update/response"
+        observer.subscribe(response_topic)
+        time.sleep(0.2)
+        observer.publish(
+            f"{topic_path}/in",
+            "(process_frame (stream_id: 2 response_topic: "
+            f"{response_topic}) (x: 7))")
+        assert wait_for(lambda: any("63" in p for p in got),
+                        timeout=15.0), got
+        observer.disconnect()
+        observer.loop_stop()
     finally:
         create.terminate()
         create.wait(timeout=5.0)
@@ -349,3 +380,75 @@ def test_pipeline_create_hooks_flag(tmp_path):
         cwd=repo, env=env, capture_output=True, text=True, timeout=60)
     assert "HOOK pipeline.process_frame:0" in good.stderr
     assert "HOOK pipeline.process_element:0" in good.stderr
+
+
+def test_system_start_status_reset_stop(tmp_path):
+    """The system lifecycle CLI (reference scripts/system_*.sh): start
+    launches broker+registrar detached, status probes, reset clears the
+    retained election record, stop tears down."""
+    import json as json_module
+    import pathlib
+    import subprocess
+    import sys
+    import time as time_module
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = {"PATH": "/usr/bin:/bin", "HOME": "/tmp",
+           "AIKO_LOG_LEVEL": "ERROR", "PYTHONPATH": str(repo),
+           "AIKO_STATE_DIR": str(tmp_path)}
+
+    def cli(*args, **kwargs):
+        return subprocess.run(
+            [sys.executable, "-m", "aiko_services_tpu", *args],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=60, **kwargs)
+
+    start = cli("system", "start", "--port", "0")
+    assert start.returncode == 0, start.stderr[-1500:]
+    state = json_module.loads(
+        (tmp_path / "aiko_tpu_system.json").read_text())
+    try:
+        status = cli("system", "status")
+        assert f":{state['port']} up" in status.stdout
+
+        # Double start refused.
+        again = cli("system", "start")
+        assert again.returncode != 0
+        assert "already started" in again.stderr
+
+        # The fabric actually works: a client process finds the
+        # registrar started by `system start`.
+        env_mqtt = dict(env, AIKO_MQTT_HOST="127.0.0.1",
+                        AIKO_MQTT_PORT=str(state["port"]),
+                        JAX_PLATFORMS="cpu")
+        listing = subprocess.run(
+            [sys.executable, "-m", "aiko_services_tpu", "pipeline",
+             "list", "-t", "mqtt", "--timeout", "15"],
+            cwd=repo, env=env_mqtt, capture_output=True, text=True,
+            timeout=60)
+        assert listing.returncode == 0
+        assert "no registrar found" not in listing.stderr
+
+        reset = subprocess.run(
+            [sys.executable, "-m", "aiko_services_tpu", "system",
+             "reset", "-t", "mqtt"],
+            cwd=repo, env=env_mqtt, capture_output=True, text=True,
+            timeout=60)
+        assert reset.returncode == 0
+        assert "cleared retained" in reset.stdout
+    finally:
+        stop = cli("system", "stop")
+    assert stop.returncode == 0, stop.stderr[-500:]
+    assert not (tmp_path / "aiko_tpu_system.json").exists()
+    # Processes actually died (kill(pid, 0) succeeds on zombies when no
+    # reaper has collected the orphans, so read /proc state instead).
+    time_module.sleep(0.3)
+    for key in ("broker_pid", "registrar_pid"):
+        stat = pathlib.Path(f"/proc/{state[key]}/stat")
+        if stat.exists():
+            proc_state = stat.read_text().rsplit(")", 1)[1].split()[0]
+            assert proc_state == "Z", f"{key} still running"
+    # And the broker port no longer answers.
+    from aiko_services_tpu.utils import mqtt_broker_reachable
+    assert not mqtt_broker_reachable("127.0.0.1", state["port"],
+                                     timeout=0.5)
