@@ -40,6 +40,18 @@ class LlamaConfig:
     max_seq: int = 8192
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # Prefill attention implementation: "dense" (einsum, materializes
+    # the [S, T] logits) or "flash" (the Pallas kernel,
+    # ops/pallas_attention.py -- O(block) memory, the long-context
+    # serving path).  Applies to prefill_into_slot, the continuous
+    # batcher's admission path; decode is O(1)-query and stays dense.
+    attention: str = "dense"
+
+    def __post_init__(self):
+        if self.attention not in ("dense", "flash"):
+            raise ValueError(
+                f"attention must be 'dense' or 'flash', "
+                f"got {self.attention!r}")
 
     @property
     def head_dim(self) -> int:
@@ -264,6 +276,11 @@ def prefill_into_slot(params: dict, config: LlamaConfig,
                 k_layer2, (slot, 0, 0, 0), (1,) + k_layer.shape[1:])
             v_row = jax.lax.dynamic_slice(
                 v_layer2, (slot, 0, 0, 0), (1,) + v_layer.shape[1:])
+            if c.attention == "flash":
+                # Causality from the traced chunk offset covers both
+                # intra-chunk masking and the unwritten cache tail.
+                from ..ops.pallas_attention import flash_attention
+                return flash_attention(q, k_row, v_row, q_offset=start)
             return attention_prefill(q, k_row, v_row, positions)
         return kv_write
 

@@ -327,6 +327,47 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
             chunk_flops * prefill_iters / elapsed / peak, 4)
     del cache
 
+    # -- long-context prefill (BASELINE config 5 shape): one 8k prompt
+    # admitted chunk-by-chunk, Pallas flash kernel vs dense attention.
+    # Dense materializes the [S, T] logits per layer; flash streams
+    # KV blocks through VMEM -- this is where the kernel pays off.
+    long_seq, long_chunk = 8192, 2048
+    for impl in ("flash", "dense"):
+        try:
+            lc = dataclasses.replace(config, max_seq=long_seq,
+                                     attention=impl)
+            lc_tokens = jnp.asarray(
+                rng.integers(0, config.vocab_size - 8, (1, long_chunk)),
+                dtype=jnp.int32)
+
+            @jax.jit
+            def longctx_loop(params, cache, tokens):
+                def body(i, carry):
+                    cache, acc = carry
+                    logits, cache = llama.prefill_into_slot.__wrapped__(
+                        params, lc, tokens + i, cache, jnp.int32(0),
+                        i * long_chunk)
+                    return (cache,
+                            acc + logits.sum().astype(jnp.float32))
+                cache, acc = lax.fori_loop(
+                    0, long_seq // long_chunk, body,
+                    (cache, jnp.float32(0.0)))
+                return acc
+
+            # longctx_loop does not donate its cache arg: allocate once
+            # OUTSIDE the timed window (the lambda must stay a single
+            # dispatch + fetch for the RTT subtraction to hold).
+            lc_cache = llama.init_cache(lc, 1, long_seq)
+            float(longctx_loop(params, lc_cache, lc_tokens))   # warm
+            elapsed = time_device_loop(
+                lambda: float(longctx_loop(params, lc_cache,
+                                           lc_tokens)), rtt)
+            result[f"llm_longctx8k_{impl}_tokens_per_sec"] = round(
+                long_seq / elapsed, 1)
+        except Exception as error:                # e.g. dense OOM at 8k
+            result[f"llm_longctx8k_{impl}_error"] = \
+                f"{type(error).__name__}: {error}"[:200]
+
     # -- end-to-end serving host loop (RTT-bound through the tunnel) -----
     batcher = ContinuousBatcher(params, config, max_slots=slots,
                                 max_seq=max_seq, prefill_chunk=chunk)

@@ -215,3 +215,34 @@ def test_batching_interleaves_long_and_short(tiny):
     finish_order = [r for r, f in order if f]
     assert finish_order.index("short1") < finish_order.index("long")
     assert finish_order.index("short2") < finish_order.index("long")
+
+
+def test_prefill_into_slot_flash_matches_dense():
+    """The Pallas flash prefill (interpret mode on CPU) produces the
+    same logits and cache as the dense path for chunked admission."""
+    import dataclasses
+
+    base = dataclasses.replace(
+        llama.LlamaConfig.tiny(vocab_size=256, max_seq=64),
+        dtype="float32")       # f32: any mismatch is semantic, not ulps
+    params = llama.init_params(jax.random.PRNGKey(0), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 256)
+
+    results = {}
+    for impl in ("dense", "flash"):
+        config = dataclasses.replace(base, attention=impl)
+        cache = llama.init_cache(config, 2, 64)
+        # Two chunks into slot 1, second offset by the first's length.
+        logits1, cache = llama.prefill_into_slot(
+            params, config, tokens[:, :8], cache, jnp.int32(1),
+            jnp.int32(0))
+        logits2, cache = llama.prefill_into_slot(
+            params, config, tokens[:, 8:], cache, jnp.int32(1),
+            jnp.int32(8))
+        results[impl] = (np.asarray(logits2, dtype=np.float32),
+                         np.asarray(cache["k"], dtype=np.float32))
+
+    np.testing.assert_allclose(results["dense"][0], results["flash"][0],
+                               atol=1e-4)
+    np.testing.assert_allclose(results["dense"][1], results["flash"][1],
+                               atol=1e-4)
