@@ -31,6 +31,8 @@ Here serving is native to the framework:
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 
 from ..models import llama
@@ -158,7 +160,9 @@ class LLM(PipelineElement):
 
     Parameters: ``max_new_tokens``, ``temperature``, ``system_prompt``,
     ``tokenizer`` (HF directory), ``checkpoint`` (orbax dir),
-    ``vocab_size``/``max_seq``/``seed`` (local tiny config).
+    ``vocab_size``/``max_seq``/``seed`` (local tiny config),
+    ``attention`` (``dense`` | ``flash`` -- the Pallas long-context
+    prefill path, 2.5x dense at 8k context).
 
     Generation runs inline on the event loop (the reference's LLM
     element equally blocks on its Ollama HTTP call); deploy this element
@@ -182,8 +186,13 @@ class LLM(PipelineElement):
                                       self._tokenizer.vocab_size)
         max_seq, _ = self.get_parameter("max_seq", 256)
         seed, _ = self.get_parameter("seed", 0)
-        config = llama.LlamaConfig.tiny(vocab_size=int(vocab),
-                                        max_seq=int(max_seq))
+        # "flash" routes chunked admission through the Pallas kernel --
+        # the long-context setting (2.5x dense at 8k on v5e).
+        attention, _ = self.get_parameter("attention", "dense")
+        config = dataclasses.replace(
+            llama.LlamaConfig.tiny(vocab_size=int(vocab),
+                                   max_seq=int(max_seq)),
+            attention=str(attention))
         params = _restore(
             llama.init_params(jax.random.PRNGKey(int(seed)), config),
             checkpoint)

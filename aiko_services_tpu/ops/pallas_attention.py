@@ -158,16 +158,25 @@ def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
         scale=d ** -0.5, causal=causal, kv_len=t,
         rows_per_head=rows_per_head)
 
+    def kv_block(bh, qi, ki, offset):
+        # Clamp dead KV blocks (fully above the causal frontier) to the
+        # last live one: pl.when only skips COMPUTE, but a repeated
+        # block index skips the HBM->VMEM DMA too -- early chunks of a
+        # long prompt otherwise fetch the whole (mostly unwritten) KV
+        # extent every layer.
+        if not causal:
+            return (bh, ki, 0)
+        q_last = offset[0] + (qi * block_q) % rows_per_head + block_q - 1
+        return (bh, jnp.minimum(ki, q_last // block_k), 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d),
                          lambda bh, qi, ki, offset: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda bh, qi, ki, offset: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda bh, qi, ki, offset: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), kv_block),
+            pl.BlockSpec((1, block_k, d), kv_block),
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh, qi, ki, offset: (bh, qi, 0)),
