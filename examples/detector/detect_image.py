@@ -36,7 +36,7 @@ def definition(in_path, out_path):
         "version": 0, "name": "detect_demo", "runtime": "jax",
         "graph": ["(read detect overlay write)"],
         "elements": [
-            el("read", "ImageReadFile", [], ["image"],
+            el("read", "ImageReadFile", ["path"], ["image"],
                {"data_sources": [f"file://{in_path}"]}),
             el("detect", "Detector", ["image"],
                ["image", "overlay", "detections"],

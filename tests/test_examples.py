@@ -13,12 +13,30 @@ import pytest
 EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
 
 
-def run_example(relative, timeout=60):
-    result = subprocess.run(
-        [sys.executable, str(EXAMPLES / relative)],
-        capture_output=True, text=True, timeout=timeout,
-        env={"PATH": "/usr/bin:/bin", "AIKO_LOG_LEVEL": "ERROR",
-             "JAX_PLATFORMS": "cpu", "HOME": "/tmp"})
+SANDBOX_ENV = {"PATH": "/usr/bin:/bin", "AIKO_LOG_LEVEL": "ERROR",
+               "JAX_PLATFORMS": "cpu", "HOME": "/tmp"}
+
+
+def run_example(relative, timeout=300, force_cpu=False):
+    """Run an example as a subprocess.  ``force_cpu`` additionally pins
+    the JAX backend programmatically before the script body: a site
+    hook may import jax at interpreter start and override the
+    JAX_PLATFORMS env var, which would send example tests to remote
+    hardware."""
+    path = str(EXAMPLES / relative)
+    if force_cpu:
+        bootstrap = (
+            "import jax, sys\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            f"path = {path!r}\n"
+            "sys.argv = [path]\n"
+            "exec(compile(open(path).read(), path, 'exec'),"
+            " {'__name__': '__main__', '__file__': path})\n")
+        command = [sys.executable, "-c", bootstrap]
+    else:
+        command = [sys.executable, path]
+    result = subprocess.run(command, capture_output=True, text=True,
+                            timeout=timeout, env=dict(SANDBOX_ENV))
     assert result.returncode == 0, result.stderr[-2000:]
     return result.stdout
 
@@ -31,4 +49,20 @@ def run_example(relative, timeout=60):
 ])
 def test_aloha_example(script, expected):
     stdout = run_example(script)
+    assert expected in stdout, stdout
+
+
+@pytest.mark.parametrize("script,expected", [
+    ("pipeline/run_local.py", "result="),
+    ("pipeline/run_remote.py", "worker added 100"),
+    ("detector/detect_image.py", "detections:"),
+    ("llm/chat.py", "DONE"),
+    ("speech/run_speech.py", "reply.wav"),
+])
+def test_model_example(script, expected):
+    """Every model-path demo runs end to end (CPU backend): these are
+    the reference's yolo/llm/speech example equivalents and break
+    silently when element contracts drift -- detect_image.py's missing
+    'path' input went unnoticed exactly this way."""
+    stdout = run_example(script, force_cpu=True)
     assert expected in stdout, stdout
