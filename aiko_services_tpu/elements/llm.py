@@ -162,7 +162,8 @@ class LLM(PipelineElement):
     ``tokenizer`` (HF directory), ``checkpoint`` (orbax dir),
     ``vocab_size``/``max_seq``/``seed`` (local tiny config),
     ``attention`` (``dense`` | ``flash`` -- the Pallas long-context
-    prefill path, 2.5x dense at 8k context).
+    prefill path, 2.5x dense at 8k context), ``quantize`` (weight-only
+    int8: halves decode's HBM stream).
 
     Generation runs inline on the event loop (the reference's LLM
     element equally blocks on its Ollama HTTP call); deploy this element
@@ -196,6 +197,12 @@ class LLM(PipelineElement):
         params = _restore(
             llama.init_params(jax.random.PRNGKey(int(seed)), config),
             checkpoint)
+        quantize, _ = self.get_parameter("quantize", False)
+        if quantize in (True, "true", "True", "1", "int8"):
+            # Weight-only int8 (models/quant.py): halves decode's HBM
+            # stream; activations/cache stay bf16.
+            from ..models.quant import quantize_params
+            params = quantize_params(params)
         self._batcher = ContinuousBatcher(params, config)
 
     def process_frame(self, stream, text=None, **inputs):

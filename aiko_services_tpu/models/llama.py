@@ -19,9 +19,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..ops.layers import (rms_norm, rope_frequencies, apply_rope, swiglu,
+from ..ops.layers import (rms_norm, rope_frequencies, apply_rope,
                           attention_prefill, attention_decode_append)
 from ..parallel.mesh import P
+from .quant import is_quantized
 
 __all__ = ["LlamaConfig", "init_params", "partition_specs",
            "cache_specs", "init_cache", "prefill", "prefill_into_slot",
@@ -154,6 +155,18 @@ def init_cache(config: LlamaConfig, batch: int,
             "v": jnp.zeros(shape, dtype=_dtype(c))}
 
 
+def matmul(x, w):
+    """``x @ w`` for raw arrays or weight-only-int8 leaves
+    (``{"int8", "scale"}``, models/quant.py).  The int8->bf16 convert
+    fuses into the dot's operand load on TPU, so int8 weights stream
+    half the HBM bytes; the per-output-channel scale applies after the
+    dot -- no dequantized weight tensor is ever materialized."""
+    if is_quantized(w):
+        return (x @ w["int8"].astype(x.dtype)) \
+            * w["scale"].astype(x.dtype)
+    return x @ w
+
+
 def _block(config: LlamaConfig, hidden, layer, kv_write):
     """One transformer block.  ``kv_write(q, k, v) -> attn_out``
     abstracts prefill-vs-decode cache handling (RoPE + cache write +
@@ -163,14 +176,16 @@ def _block(config: LlamaConfig, hidden, layer, kv_write):
     hd = c.head_dim
 
     x = rms_norm(hidden, layer["attn_norm"], c.norm_eps)
-    q = (x @ layer["wq"]).reshape(b, s, c.n_heads, hd)
-    k = (x @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
-    v = (x @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    q = matmul(x, layer["wq"]).reshape(b, s, c.n_heads, hd)
+    k = matmul(x, layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = matmul(x, layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
     attn_out = kv_write(q, k, v)
-    hidden = hidden + attn_out.reshape(b, s, c.n_heads * hd) @ layer["wo"]
+    hidden = hidden + matmul(attn_out.reshape(b, s, c.n_heads * hd),
+                             layer["wo"])
 
     x = rms_norm(hidden, layer["mlp_norm"], c.norm_eps)
-    hidden = hidden + swiglu(x, layer["w_gate"], layer["w_up"],
+    gate = jax.nn.silu(matmul(x, layer["w_gate"]))
+    hidden = hidden + matmul(gate * matmul(x, layer["w_up"]),
                              layer["w_down"])
     return hidden
 
@@ -202,7 +217,7 @@ def _forward_layers(params: dict, config: LlamaConfig, hidden,
         layer_step, hidden,
         (params["layers"], cache["k"], cache["v"]))
     hidden = rms_norm(hidden, params["final_norm"], config.norm_eps)
-    logits = hidden @ params["unembed"]
+    logits = matmul(hidden, params["unembed"])
     if cache_from_updates is not None:
         return logits, cache_from_updates(updates)
     k_new, v_new = updates

@@ -327,6 +327,21 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
             chunk_flops * prefill_iters / elapsed / peak, 4)
     del cache
 
+    # -- weight-only int8 decode: same loop, quantized tree ---------------
+    from aiko_services_tpu.models.quant import quantize_params
+
+    qparams = quantize_params(params)
+    qcache = llama.init_cache(config, slots, max_seq)
+    int(decode_loop(qparams, tokens, qcache, lengths))   # compile + warm
+    qcache = llama.init_cache(config, slots, max_seq)
+    elapsed = time_device_loop(
+        lambda: int(decode_loop(qparams, tokens, qcache, lengths)), rtt)
+    result["llm_int8_tokens_per_sec"] = round(
+        slots * decode_iters / elapsed, 1)
+    result["llm_int8_decode_step_ms"] = round(
+        elapsed / decode_iters * 1000, 3)
+    del qparams, qcache
+
     # -- long-context prefill (BASELINE config 5 shape): one 8k prompt
     # admitted chunk-by-chunk, Pallas flash kernel vs dense attention.
     # Dense materializes the [S, T] logits per layer; flash streams

@@ -1,0 +1,119 @@
+"""Weight-only int8 quantization (models/quant.py): exactness on
+grid-aligned weights, bounded error on arbitrary ones, and the serving
+paths running unchanged on a quantized tree."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.models.quant import (QUANTIZED_LAYER_KEYS,
+                                            is_quantized, quantize_params,
+                                            quantize_weight)
+
+
+def grid_aligned_params(config):
+    """Params whose matmul weights sit exactly on an int8 grid, so
+    quantization is lossless and quant-vs-raw forward must agree to
+    float rounding only."""
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    key = jax.random.PRNGKey(42)
+
+    def align(weight):
+        nonlocal key
+        key, sub1, sub2 = jax.random.split(key, 3)
+        levels = jax.random.randint(sub1, weight.shape, -127, 128)
+        # Pin level 127 in every output channel so quantization recovers
+        # exactly this scale (scale = channel max / 127).
+        levels = levels.at[..., 0, :].set(127)
+        scale = jax.random.uniform(sub2, weight.shape[-1:],
+                                   minval=0.5, maxval=2.0) / 127.0
+        return (levels * scale).astype(weight.dtype) * 0.05
+
+    layers = dict(params["layers"])
+    for name in QUANTIZED_LAYER_KEYS:
+        layers[name] = align(layers[name])
+    params["layers"] = layers
+    params["unembed"] = align(params["unembed"])
+    return params
+
+
+def test_quantize_tree_structure():
+    config = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    quantized = quantize_params(params)
+    for name in QUANTIZED_LAYER_KEYS:
+        leaf = quantized["layers"][name]
+        assert is_quantized(leaf)
+        assert leaf["int8"].dtype == jnp.int8
+        assert leaf["int8"].shape == params["layers"][name].shape
+        assert leaf["scale"].shape[-1] == leaf["int8"].shape[-1]
+    assert is_quantized(quantized["unembed"])
+    assert not is_quantized(quantized["embed"])
+    # ~2x smaller where it counts.
+    raw = params["layers"]["w_gate"].nbytes
+    packed = quantized["layers"]["w_gate"]["int8"].nbytes \
+        + quantized["layers"]["w_gate"]["scale"].nbytes
+    assert packed < raw * 0.55
+
+
+def test_quantize_roundtrip_error_bounded():
+    weight = jax.random.normal(jax.random.PRNGKey(1), (64, 128),
+                               jnp.float32)
+    q = quantize_weight(weight)
+    rebuilt = q["int8"].astype(jnp.float32) * q["scale"].astype(
+        jnp.float32)
+    per_channel_max = jnp.abs(weight).max(axis=0)
+    error = jnp.abs(rebuilt - weight).max(axis=0)
+    # Symmetric int8: error <= half a step = max/254 per channel.
+    assert bool((error <= per_channel_max / 254 + 1e-7).all())
+
+
+def test_quantized_forward_matches_on_grid_weights():
+    """Grid-aligned weights quantize losslessly: prefill + decode on the
+    quantized tree match the raw tree to float tolerance."""
+    config = dataclasses.replace(
+        llama.LlamaConfig.tiny(vocab_size=256, max_seq=32),
+        dtype="float32")
+    params = grid_aligned_params(config)
+    quantized = quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 9), 0, 256)
+
+    raw_logits, raw_cache = llama.prefill(
+        params, config, tokens[:, :8], llama.init_cache(config, 2, 32),
+        jnp.zeros(2, dtype=jnp.int32))
+    q_logits, q_cache = llama.prefill(
+        quantized, config, tokens[:, :8],
+        llama.init_cache(config, 2, 32), jnp.zeros(2, dtype=jnp.int32))
+    np.testing.assert_allclose(np.asarray(raw_logits),
+                               np.asarray(q_logits), atol=2e-3)
+
+    raw_step, _ = llama.decode_step(params, config, tokens[:, 8],
+                                    raw_cache,
+                                    jnp.full((2,), 8, jnp.int32))
+    q_step, _ = llama.decode_step(quantized, config, tokens[:, 8],
+                                  q_cache, jnp.full((2,), 8, jnp.int32))
+    np.testing.assert_allclose(np.asarray(raw_step),
+                               np.asarray(q_step), atol=2e-3)
+
+
+def test_batcher_serves_quantized_params():
+    """The continuous batcher runs unchanged on a quantized tree (jit
+    treats the {"int8","scale"} dicts as ordinary pytree leaves)."""
+    from aiko_services_tpu.models import ContinuousBatcher, Request
+    from aiko_services_tpu.models.tokenizer import ByteTokenizer
+
+    config = llama.LlamaConfig.tiny()
+    params = quantize_params(
+        llama.init_params(jax.random.PRNGKey(0), config))
+    tok = ByteTokenizer()
+    out = []
+    batcher = ContinuousBatcher(params, config, max_slots=2, max_seq=64,
+                                prefill_chunk=16)
+    batcher.submit(Request("r1", tok.encode("aloha"), max_new_tokens=5,
+                           emit=lambda r, t, f: out.append(t)))
+    steps = batcher.run_until_drained(max_steps=200)
+    assert steps < 200
+    assert len(out) == 5
