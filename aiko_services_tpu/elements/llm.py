@@ -198,11 +198,17 @@ class LLM(PipelineElement):
             llama.init_params(jax.random.PRNGKey(int(seed)), config),
             checkpoint)
         quantize, _ = self.get_parameter("quantize", False)
-        if quantize in (True, "true", "True", "1", "int8"):
+        normalized = str(quantize).strip().lower()
+        if normalized in ("true", "1", "yes", "on", "int8"):
             # Weight-only int8 (models/quant.py): halves decode's HBM
             # stream; activations/cache stay bf16.
             from ..models.quant import quantize_params
             params = quantize_params(params)
+        elif normalized not in ("false", "0", "no", "off", "none", ""):
+            # A typo must not silently serve bf16 at half the promised
+            # decode rate.
+            raise ValueError(
+                f"quantize={quantize!r}: use true/false or int8")
         self._batcher = ContinuousBatcher(params, config)
 
     def process_frame(self, stream, text=None, **inputs):

@@ -55,7 +55,13 @@ def is_quantized(leaf) -> bool:
 
 def quantize_params(params: dict) -> dict:
     """Quantize a llama parameter tree (models/llama.py:init_params
-    layout) for weight-only int8 serving."""
+    layout) for weight-only int8 serving.
+
+    Single-host serving only for now: the quantized tree's structure
+    (dict leaves) does not match ``llama.partition_specs``, so it cannot
+    be sharded with the TP/fsdp layout -- extend partition_specs (int8
+    inheriting the weight's spec, scale sharded on the output axis)
+    before composing with the multichip paths."""
     layers = dict(params["layers"])
     for key in QUANTIZED_LAYER_KEYS:
         layers[key] = quantize_weight(layers[key])

@@ -452,3 +452,50 @@ def test_system_start_status_reset_stop(tmp_path):
     from aiko_services_tpu.utils import mqtt_broker_reachable
     assert not mqtt_broker_reachable("127.0.0.1", state["port"],
                                      timeout=0.5)
+
+
+def test_transport_reconnects_after_broker_restart(monkeypatch):
+    """Broker dies and comes back on the same port: the MQTT transport's
+    network loop reconnects with backoff and its on_connect re-subscribes
+    every tracked topic, so delivery resumes without application action
+    (raw mini_mqtt.Client deliberately leaves re-subscription to
+    on_connect, paho-style)."""
+    from aiko_services_tpu.transport.mqtt import MQTTMessage
+    from aiko_services_tpu.utils.misc import find_free_port
+
+    port = find_free_port()
+    first = BrokerProcess(port=port, export_env=False).start()
+    monkeypatch.setenv("AIKO_MQTT_HOST", "127.0.0.1")
+    monkeypatch.setenv("AIKO_MQTT_PORT", str(port))
+    monkeypatch.delenv("AIKO_MQTT_HOSTS", raising=False)
+
+    got = []
+    transport = MQTTMessage(
+        message_handler=lambda topic, payload: got.append(str(payload)))
+    second = None
+    try:
+        transport.subscribe("restart/topic")
+        transport.connect()
+        publisher = connect_client(first)
+        publisher.publish("restart/topic", "before")
+        okay = wait_for(lambda: "before" in got)
+        publisher.disconnect()
+        publisher.loop_stop()
+        assert okay
+
+        first.stop()                               # broker gone
+        time.sleep(0.5)
+        second = BrokerProcess(port=port, export_env=False).start()
+        publisher = connect_client(second)
+        deadline = time.time() + 15.0
+        while time.time() < deadline and "after" not in got:
+            publisher.publish("restart/topic", "after")
+            time.sleep(0.25)
+        assert "after" in got, "transport never recovered delivery"
+        publisher.disconnect()
+        publisher.loop_stop()
+    finally:
+        transport.disconnect()
+        first.stop()                               # no-op if stopped
+        if second is not None:
+            second.stop()
