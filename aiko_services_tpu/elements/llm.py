@@ -163,7 +163,8 @@ class LLM(PipelineElement):
     ``vocab_size``/``max_seq``/``seed`` (local tiny config),
     ``attention`` (``dense`` | ``flash`` -- the Pallas long-context
     prefill path, 2.5x dense at 8k context), ``quantize`` (weight-only
-    int8: halves decode's HBM stream).
+    int8: halves decode's HBM stream), ``decode_block`` (fuse N decode
+    steps per device dispatch: amortizes host round trips).
 
     Generation runs inline on the event loop (the reference's LLM
     element equally blocks on its Ollama HTTP call); deploy this element
@@ -209,7 +210,9 @@ class LLM(PipelineElement):
             # decode rate.
             raise ValueError(
                 f"quantize={quantize!r}: use true/false or int8")
-        self._batcher = ContinuousBatcher(params, config)
+        decode_block, _ = self.get_parameter("decode_block", 1)
+        self._batcher = ContinuousBatcher(
+            params, config, decode_block=int(decode_block))
 
     def process_frame(self, stream, text=None, **inputs):
         self._ensure_model()

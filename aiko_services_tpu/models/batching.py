@@ -55,28 +55,24 @@ class Request:
     done: bool = False
 
 
-@jax.jit
-def _select_tokens(key: jax.Array, logits: jax.Array,
-                   temperatures: jax.Array) -> jax.Array:
-    """Per-slot sampling in one draw: rows with temperature 0 take the
-    argmax, rows with temperature > 0 take a categorical sample at their
-    OWN temperature (scale each row's logits before one batched draw)."""
-    greedy = jnp.argmax(logits, axis=-1)
-    safe = jnp.maximum(temperatures, 0.05)[:, None]
-    sampled = jax.random.categorical(
-        key, logits.astype(jnp.float32) / safe, axis=-1)
-    return jnp.where(temperatures > 0, sampled, greedy)
+_select_tokens = jax.jit(llama.select_tokens)
 
 
 class ContinuousBatcher:
     def __init__(self, params, config: llama.LlamaConfig,
                  max_slots: int = 8, max_seq: int | None = None,
-                 prefill_chunk: int = 512, rng_seed: int = 0):
+                 prefill_chunk: int = 512, rng_seed: int = 0,
+                 decode_block: int = 1):
         self.params = params
         self.config = config
         self.max_slots = max_slots
         self.max_seq = max_seq or config.max_seq
         self.prefill_chunk = min(prefill_chunk, self.max_seq)
+        # >1: fuse that many decode iterations (sampling included) into
+        # one device dispatch when no admission is in flight -- the host
+        # round trip stops bounding tokens/s.  Tokens a request emits
+        # past its EOS/budget inside a block are discarded host-side.
+        self.decode_block = max(1, int(decode_block))
         self.cache = llama.init_cache(config, max_slots, self.max_seq)
         self.lengths = np.zeros(max_slots, dtype=np.int32)
         self.current = np.zeros(max_slots, dtype=np.int32)
@@ -179,7 +175,12 @@ class ContinuousBatcher:
         self._prefill_tick()
         decoding = [i for i in range(self.max_slots) if self.decoding[i]]
         if decoding:
-            self._decode_tick(decoding)
+            if self.decode_block > 1 and not self._prefilling:
+                self._decode_block_tick(decoding)
+            else:
+                # Admissions in flight: single ticks keep the
+                # chunked-prefill interleaving guarantee.
+                self._decode_tick(decoding)
         return sum(1 for r in self.slots if r is not None)
 
     def _decode_tick(self, decoding: list[int]):
@@ -204,6 +205,29 @@ class ContinuousBatcher:
             token = int(next_tokens[i])
             self.current[i] = token
             self._emit(request, token)
+
+    def _decode_block_tick(self, decoding: list[int]):
+        """decode_block fused iterations in one dispatch
+        (llama.decode_block); de-multiplex host-side, truncating each
+        request at its EOS/budget (overshoot KV lands beyond the freed
+        slot's next occupant's length mask, so it is never read)."""
+        self._key, sub = jax.random.split(self._key)
+        emitted, self.cache = llama.decode_block(
+            self.params, self.config, jnp.asarray(self.current),
+            self.cache, jnp.asarray(self.lengths),
+            jnp.asarray(self.decoding), jnp.asarray(self.temperatures),
+            sub, num_steps=self.decode_block)
+        emitted = np.asarray(jax.device_get(emitted))   # [K, B]
+        self.steps += 1
+        for i in decoding:
+            request = self.slots[i]
+            for block_step in range(self.decode_block):
+                if self.slots[i] is not request:        # finished
+                    break
+                self.lengths[i] += 1
+                token = int(emitted[block_step, i])
+                self.current[i] = token
+                self._emit(request, token)
 
     def _emit(self, request: Request, token: int):
         request.generated += 1

@@ -398,10 +398,29 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
     for i in range(slots):
         batcher.submit(Request(
             f"r{i}", list(rng.integers(0, config.vocab_size, prompt_len)),
-            max_new_tokens=32, emit=emit))
+            max_new_tokens=128, emit=emit))    # same budget as blocked
     batcher.run_until_drained(max_steps=10_000)
     elapsed = time.perf_counter() - start
     result["llm_serving_host_loop_tokens_per_sec"] = round(
+        emitted["n"] / elapsed, 1)
+
+    # -- same loop with fused decode blocks: one dispatch per 16 decode
+    # steps, so the tunnel RTT stops bounding the host loop.
+    blocked = ContinuousBatcher(params, config, max_slots=slots,
+                                max_seq=max_seq, prefill_chunk=chunk,
+                                decode_block=16)
+    blocked.submit(Request("warm", list(rng.integers(
+        0, config.vocab_size, 8)), max_new_tokens=32))
+    blocked.run_until_drained(max_steps=100)
+    emitted["n"] = 0
+    start = time.perf_counter()
+    for i in range(slots):
+        blocked.submit(Request(
+            f"b{i}", list(rng.integers(0, config.vocab_size, prompt_len)),
+            max_new_tokens=128, emit=emit))
+    blocked.run_until_drained(max_steps=10_000)
+    elapsed = time.perf_counter() - start
+    result["llm_serving_blocked_tokens_per_sec"] = round(
         emitted["n"] / elapsed, 1)
     return result
 

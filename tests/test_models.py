@@ -246,3 +246,61 @@ def test_prefill_into_slot_flash_matches_dense():
                                atol=1e-4)
     np.testing.assert_allclose(results["dense"][1], results["flash"][1],
                                atol=1e-4)
+
+
+def test_decode_block_matches_single_steps(tiny):
+    """decode_block=K (fused device loop) emits exactly the token
+    streams decode_block=1 produces (greedy), including requests whose
+    budgets end mid-block (overshoot discarded) and staggered lengths."""
+    from aiko_services_tpu.models import ContinuousBatcher, Request
+    from aiko_services_tpu.models.tokenizer import ByteTokenizer
+
+    config, params = tiny
+    tok = ByteTokenizer()
+
+    def run(block):
+        out = {}
+        batcher = ContinuousBatcher(params, config, max_slots=4,
+                                    max_seq=64, prefill_chunk=16,
+                                    decode_block=block)
+        for i, budget in enumerate((5, 9, 4)):     # none divisible by 4
+            batcher.submit(Request(
+                f"r{i}", tok.encode(f"prompt {i}"),
+                max_new_tokens=budget,
+                emit=lambda r, t, f: out.setdefault(r, []).append(t)))
+        steps = batcher.run_until_drained(max_steps=500)
+        assert steps < 500
+        assert batcher.active_count == 0
+        return out
+
+    single = run(1)
+    blocked = run(4)
+    assert single == blocked
+    assert [len(v) for v in blocked.values()] == [5, 9, 4]
+
+
+def test_decode_block_interleaves_with_admission(tiny):
+    """A request submitted while a blocked decode is running still
+    admits (the batcher falls back to single ticks during prefill) and
+    both streams complete."""
+    from aiko_services_tpu.models import ContinuousBatcher, Request
+    from aiko_services_tpu.models.tokenizer import ByteTokenizer
+
+    config, params = tiny
+    tok = ByteTokenizer()
+    out = {}
+    batcher = ContinuousBatcher(params, config, max_slots=2, max_seq=64,
+                                prefill_chunk=8, decode_block=4)
+    batcher.submit(Request(
+        "first", tok.encode("hello"), max_new_tokens=12,
+        emit=lambda r, t, f: out.setdefault(r, []).append(t)))
+    for _ in range(2):
+        batcher.step()                   # first is generating
+    batcher.submit(Request(
+        "late", tok.encode("a much longer prompt arriving late"),
+        max_new_tokens=6,
+        emit=lambda r, t, f: out.setdefault(r, []).append(t)))
+    steps = batcher.run_until_drained(max_steps=500)
+    assert steps < 500
+    assert len(out["first"]) == 12
+    assert len(out["late"]) == 6
