@@ -117,7 +117,8 @@ class LLMService(Actor):
         self.ec_producer.update("queued", self.batcher.queue_depth)
         self.ec_producer.update("tokens_emitted",
                                 self.batcher.tokens_emitted)
-        if active or self.batcher.queue_depth:
+        if active or self.batcher.queue_depth \
+                or self.batcher.blocks_in_flight:
             self.runtime.engine.post(self._pump)    # interleave, not block
         else:
             self._pumping = False
@@ -164,7 +165,9 @@ class LLM(PipelineElement):
     ``attention`` (``dense`` | ``flash`` -- the Pallas long-context
     prefill path, 2.5x dense at 8k context), ``quantize`` (weight-only
     int8: halves decode's HBM stream), ``decode_block`` (fuse N decode
-    steps per device dispatch: amortizes host round trips).
+    steps per device dispatch: amortizes host round trips), ``inflight``
+    (keep N fused blocks in flight, chained device-side: hides the
+    dispatch round trip behind device compute).
 
     Generation runs inline on the event loop (the reference's LLM
     element equally blocks on its Ollama HTTP call); deploy this element
@@ -211,8 +214,10 @@ class LLM(PipelineElement):
             raise ValueError(
                 f"quantize={quantize!r}: use true/false or int8")
         decode_block, _ = self.get_parameter("decode_block", 1)
+        inflight, _ = self.get_parameter("inflight", 2)
         self._batcher = ContinuousBatcher(
-            params, config, decode_block=int(decode_block))
+            params, config, decode_block=int(decode_block),
+            inflight=int(inflight))
 
     def process_frame(self, stream, text=None, **inputs):
         self._ensure_model()

@@ -10,16 +10,28 @@ requests merge into device batches and de-multiplex back to per-request
 token streams.
 
 - ``max_slots`` sequences decode together as one [B] ``decode_step``;
-- admission is CHUNKED and INTERLEAVED: each ``step()`` prefills at most
-  ``prefill_chunk`` prompt tokens -- written straight into the admitted
-  slot's region of the batched cache (``llama.prefill_into_slot``; no
-  scratch cache, no full-extent scatter) -- and then runs one decode
-  tick for every already-generating slot.  A long prompt therefore
-  never stalls active decodes beyond one chunk's latency, and admission
-  costs one in-place chunk write instead of a max_seq-extent copy;
+- admission is CHUNKED and INTERLEAVED: prompt tokens are written
+  chunk-at-a-time straight into the admitted slot's region of the
+  batched cache (``llama.prefill_into_slot``; no scratch cache, no
+  full-extent scatter), interleaved with decode ticks.  With
+  ``decode_block == 1`` each ``step()`` prefills at most ONE
+  ``prefill_chunk`` -- a long prompt never stalls active decodes beyond
+  one chunk's latency.  With ``decode_block > 1`` (the pipelined path,
+  below) a burst of admissions prefills one chunk PER admitting slot
+  per step: the chunks are async dispatches chained on the cache, so a
+  burst costs device time, not host round trips, and decode stall is
+  bounded by one fused block's latency anyway;
 - finished sequences (EOS or token budget) free their slot immediately;
   a long generation never blocks a short one (continuous, not static,
   batching);
+- with ``decode_block > 1`` the decode loop is PIPELINED: the batcher
+  keeps ``inflight`` fused blocks in flight, chaining each dispatch off
+  the previous block's DEVICE-side carries (tokens/lengths/key/cache --
+  ``llama.decode_block`` returns them) so the host never waits a tunnel
+  round trip between dispatches; emitted tokens are copied back
+  asynchronously and retired one block behind.  A request's tokens past
+  its EOS/budget inside in-flight blocks are discarded host-side (the
+  same overshoot semantics a single fused block already had);
 - the engine is synchronous and thread-agnostic: ``step()`` advances one
   tick and invokes per-request ``emit`` callbacks.  The serving element
   runs it on the event engine and pushes tokens to actor queues.
@@ -28,6 +40,7 @@ token streams.
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from functools import partial
 from typing import Callable
 
@@ -58,21 +71,37 @@ class Request:
 _select_tokens = jax.jit(llama.select_tokens)
 
 
+class _InflightBlock:
+    """One dispatched-but-unretired fused decode block."""
+    __slots__ = ("emitted", "snapshot", "firsts", "steps")
+
+    def __init__(self, emitted, snapshot, firsts, steps):
+        self.emitted = emitted        # [steps, B] device, copy in flight
+        self.snapshot = snapshot      # [(slot, request)] active at dispatch
+        self.firsts = firsts          # [(slot, request, first_dev)]
+        self.steps = steps
+
+
 class ContinuousBatcher:
     def __init__(self, params, config: llama.LlamaConfig,
                  max_slots: int = 8, max_seq: int | None = None,
                  prefill_chunk: int = 512, rng_seed: int = 0,
-                 decode_block: int = 1):
+                 decode_block: int = 1, inflight: int = 2):
         self.params = params
         self.config = config
         self.max_slots = max_slots
         self.max_seq = max_seq or config.max_seq
         self.prefill_chunk = min(prefill_chunk, self.max_seq)
         # >1: fuse that many decode iterations (sampling included) into
-        # one device dispatch when no admission is in flight -- the host
-        # round trip stops bounding tokens/s.  Tokens a request emits
-        # past its EOS/budget inside a block are discarded host-side.
+        # one device dispatch -- the host round trip stops bounding
+        # tokens/s.  Tokens a request emits past its EOS/budget inside a
+        # block are discarded host-side.
         self.decode_block = max(1, int(decode_block))
+        # How many fused blocks to keep in flight (decode_block > 1
+        # only).  Each dispatch chains off the previous block's device
+        # carries, so depth d hides up to d * block_compute of host
+        # round-trip latency behind device work.
+        self.inflight = max(1, int(inflight))
         self.cache = llama.init_cache(config, max_slots, self.max_seq)
         self.lengths = np.zeros(max_slots, dtype=np.int32)
         self.current = np.zeros(max_slots, dtype=np.int32)
@@ -82,6 +111,16 @@ class ContinuousBatcher:
         self.pending: list[Request] = []
         self._prefilling: list[int] = []      # slot FIFO, round-robin
         self._key = jax.random.PRNGKey(rng_seed)
+        # pipelining state (decode_block > 1): device-side carries of
+        # the latest dispatched block, cached device mirrors of the
+        # active/temperature rows (re-uploaded only when they change),
+        # first-token futures from prefill completions not yet folded
+        # into a dispatch, and the in-flight block queue.
+        self._chain: tuple | None = None      # (tokens_dev, lengths_dev)
+        self._active_dev = None
+        self._temps_dev = None
+        self._pending_first: dict[int, tuple] = {}   # slot -> (req, dev)
+        self._inflight: deque[_InflightBlock] = deque()
         # perf counters
         self.tokens_emitted = 0
         self.steps = 0
@@ -113,51 +152,69 @@ class ContinuousBatcher:
             self.lengths[slot] = 0
             self.current[slot] = 0
             self.temperatures[slot] = request.temperature
+            self._temps_dev = None
             self.decoding[slot] = False
             self._prefilling.append(slot)
 
     def _prefill_tick(self):
-        """Write at most ONE chunk (<= prefill_chunk tokens) of the
-        longest-waiting admitting prompt into its slot's cache region.
-        Bounds the latency a decode tick can suffer from admissions."""
-        if not self._prefilling:
-            return
-        slot = self._prefilling.pop(0)
-        request = self.slots[slot]
-        if request is None:                     # cancelled while waiting
-            return
-        prompt = request.prompt_tokens
-        # Clamp the write start so a full chunk always fits inside the
-        # cache (a spilling dynamic_update_slice would clamp internally
-        # and corrupt earlier positions).  A clamped start re-writes the
-        # overlap with byte-identical KV (same tokens, same positions),
-        # so correctness is unaffected and only the final chunk pays.
-        start = min(request.prefill_pos, self.max_seq - self.prefill_chunk)
-        chunk_tokens = prompt[start:start + self.prefill_chunk]
-        # Always pad to the full chunk: one compiled shape for every
-        # admission.  Pad positions hold garbage KV, but decode writes
-        # each position before the length mask ever admits it, and the
-        # causal prefill mask never looks past the query position.
-        padded = np.zeros((1, self.prefill_chunk), dtype=np.int32)
-        padded[0, :len(chunk_tokens)] = chunk_tokens
-        logits, self.cache = llama.prefill_into_slot(
-            self.params, self.config, jnp.asarray(padded), self.cache,
-            jnp.int32(slot), jnp.int32(start))
-        self.prefill_tokens += start + len(chunk_tokens) \
-            - request.prefill_pos
-        request.prefill_pos = start + len(chunk_tokens)
-        if request.prefill_pos < len(prompt):
-            self._prefilling.append(slot)       # more chunks to go
-            return
-        # Final chunk: sample the first generated token from the last
-        # real prompt position's logits and hand the slot to decode.
-        last = len(prompt) - start - 1
-        first = self._sample(logits[:, last, :], request.temperature)
-        first_token = int(jax.device_get(first)[0])
-        self.lengths[slot] = len(prompt)
-        self.current[slot] = first_token
-        self.decoding[slot] = True
-        self._emit(request, first_token)
+        """Advance admissions by one chunk (<= prefill_chunk tokens)
+        each.  Pipelined path (decode_block > 1): every admitting slot
+        advances -- the chunks are async dispatches chained on the
+        cache, so a burst costs device time, not host round trips.
+        Synchronous path (decode_block == 1): at most ONE chunk total,
+        preserving the one-chunk decode-stall bound (each chunk's
+        completion fetch blocks the host there)."""
+        budget = len(self._prefilling) if self.decode_block > 1 \
+            else min(1, len(self._prefilling))
+        for _ in range(budget):
+            slot = self._prefilling.pop(0)
+            request = self.slots[slot]
+            if request is None:                 # cancelled while waiting
+                continue
+            prompt = request.prompt_tokens
+            # Clamp the write start so a full chunk always fits inside
+            # the cache (a spilling dynamic_update_slice would clamp
+            # internally and corrupt earlier positions).  A clamped
+            # start re-writes the overlap with byte-identical KV (same
+            # tokens, same positions), so correctness is unaffected and
+            # only the final chunk pays.
+            start = min(request.prefill_pos,
+                        self.max_seq - self.prefill_chunk)
+            chunk_tokens = prompt[start:start + self.prefill_chunk]
+            # Always pad to the full chunk: one compiled shape for every
+            # admission.  Pad positions hold garbage KV, but decode
+            # writes each position before the length mask ever admits
+            # it, and the causal prefill mask never looks past the
+            # query position.
+            padded = np.zeros((1, self.prefill_chunk), dtype=np.int32)
+            padded[0, :len(chunk_tokens)] = chunk_tokens
+            logits, self.cache = llama.prefill_into_slot(
+                self.params, self.config, jnp.asarray(padded),
+                self.cache, jnp.int32(slot), jnp.int32(start))
+            self.prefill_tokens += start + len(chunk_tokens) \
+                - request.prefill_pos
+            request.prefill_pos = start + len(chunk_tokens)
+            if request.prefill_pos < len(prompt):
+                self._prefilling.append(slot)   # more chunks to go
+                continue
+            # Final chunk: sample the first generated token from the
+            # last real prompt position's logits and hand the slot to
+            # decode.
+            last = len(prompt) - start - 1
+            first = self._sample(logits[:, last, :], request.temperature)
+            self.lengths[slot] = len(prompt)
+            self.decoding[slot] = True
+            self._active_dev = None
+            if self.decode_block > 1:
+                # Pipelined path: don't fetch (a tunnel round trip per
+                # admission) -- fold the device scalar into the next
+                # block dispatch and emit it when that block retires.
+                first.copy_to_host_async()
+                self._pending_first[slot] = (request, first)
+            else:
+                first_token = int(jax.device_get(first)[0])
+                self.current[slot] = first_token
+                self._emit(request, first_token)
 
     # -- decode ------------------------------------------------------------
 
@@ -168,19 +225,33 @@ class ContinuousBatcher:
         return llama.greedy_sample(logits)
 
     def step(self) -> int:
-        """Admit pending requests, advance at most one prefill chunk,
-        run one decode tick across all generating slots, emit tokens.
-        Returns the number of occupied slots (prefilling + decoding)."""
+        """Admit pending requests, advance one prefill chunk per
+        admitting slot, dispatch/retire decode work across all
+        generating slots, emit tokens.  Returns the number of occupied
+        slots (prefilling + decoding)."""
         self._admit()
         self._prefill_tick()
         decoding = [i for i in range(self.max_slots) if self.decoding[i]]
-        if decoding:
-            if self.decode_block > 1 and not self._prefilling:
-                self._decode_block_tick(decoding)
-            else:
-                # Admissions in flight: single ticks keep the
-                # chunked-prefill interleaving guarantee.
-                self._decode_tick(decoding)
+        if self.decode_block > 1:
+            if decoding:
+                # Top the pipeline up to `inflight` blocks, then retire
+                # the oldest: steady state is one dispatch + one retire
+                # per step, with the retire's host copy overlapping the
+                # newer blocks' device compute.  Stop early once the
+                # outstanding blocks already cover every active
+                # request's remaining budget (EOS can still cut a
+                # stream shorter; that overshoot is discarded).
+                remaining = max(
+                    self.slots[i].max_new_tokens - self.slots[i].generated
+                    for i in decoding if self.slots[i] is not None)
+                while (len(self._inflight) < self.inflight
+                       and len(self._inflight) * self.decode_block
+                       < remaining):
+                    self._dispatch_block(decoding)
+            if self._inflight:
+                self._retire_block()
+        elif decoding:
+            self._decode_tick(decoding)
         return sum(1 for r in self.slots if r is not None)
 
     def _decode_tick(self, decoding: list[int]):
@@ -206,35 +277,78 @@ class ContinuousBatcher:
             self.current[i] = token
             self._emit(request, token)
 
-    def _decode_block_tick(self, decoding: list[int]):
-        """decode_block fused iterations in one dispatch
-        (llama.decode_block); de-multiplex host-side, truncating each
-        request at its EOS/budget (overshoot KV lands beyond the freed
-        slot's next occupant's length mask, so it is never read)."""
-        self._key, sub = jax.random.split(self._key)
-        emitted, self.cache = llama.decode_block(
-            self.params, self.config, jnp.asarray(self.current),
-            self.cache, jnp.asarray(self.lengths),
-            jnp.asarray(self.decoding), jnp.asarray(self.temperatures),
-            sub, num_steps=self.decode_block)
-        emitted = np.asarray(jax.device_get(emitted))   # [K, B]
+    def _dispatch_block(self, decoding: list[int]):
+        """Enqueue one fused decode block chained off the previous
+        block's device carries.  No host synchronization: tokens and
+        lengths come from the chain (with prefill-completion overrides
+        applied on device), the key chains through the kernel, and the
+        emitted tokens start copying to the host asynchronously."""
+        if self._chain is None:
+            tokens = jnp.asarray(self.current)
+            lengths = jnp.asarray(self.lengths)
+        else:
+            tokens, lengths = self._chain
+        firsts = []
+        for slot in sorted(self._pending_first):
+            request, first = self._pending_first[slot]
+            tokens = tokens.at[slot].set(first[0])
+            lengths = lengths.at[slot].set(len(request.prompt_tokens))
+            firsts.append((slot, request, first))
+        self._pending_first.clear()
+        if self._active_dev is None:
+            self._active_dev = jnp.asarray(self.decoding)
+        if self._temps_dev is None:
+            self._temps_dev = jnp.asarray(self.temperatures)
+        emitted, tokens_n, lengths_n, self._key, self.cache = \
+            llama.decode_block(
+                self.params, self.config, tokens, self.cache, lengths,
+                self._active_dev, self._temps_dev, self._key,
+                num_steps=self.decode_block)
+        emitted.copy_to_host_async()
+        self._chain = (tokens_n, lengths_n)
+        for i in decoding:                      # host mirror (clamped)
+            self.lengths[i] = min(self.lengths[i] + self.decode_block,
+                                  self.max_seq - 1)
+        self._inflight.append(_InflightBlock(
+            emitted, [(i, self.slots[i]) for i in decoding], firsts,
+            self.decode_block))
+
+    def _retire_block(self):
+        """Fetch the OLDEST in-flight block's tokens (the async copy
+        has been overlapping newer blocks' compute) and de-multiplex
+        host-side, truncating each request at its EOS/budget (overshoot
+        KV lands beyond the freed slot's next occupant's length mask,
+        so it is never read).  A slot freed and re-admitted while this
+        block was in flight is skipped via the request snapshot."""
+        blk = self._inflight.popleft()
+        emitted = np.asarray(blk.emitted)       # [steps, B]
         self.steps += 1
-        for i in decoding:
-            request = self.slots[i]
-            for block_step in range(self.decode_block):
-                if self.slots[i] is not request:        # finished
+        for slot, request, first in blk.firsts:
+            if self.slots[slot] is request and not request.done:
+                token = int(np.asarray(first)[0])
+                self.current[slot] = token
+                self._emit(request, token)
+        for slot, request in blk.snapshot:
+            if request is None or self.slots[slot] is not request:
+                continue
+            for block_step in range(blk.steps):
+                if self.slots[slot] is not request:     # finished
                     break
-                self.lengths[i] += 1
-                token = int(emitted[block_step, i])
-                self.current[i] = token
+                token = int(emitted[block_step, slot])
+                self.current[slot] = token
                 self._emit(request, token)
 
     def _emit(self, request: Request, token: int):
         request.generated += 1
         self.tokens_emitted += 1
+        # Cache position of the token currently being generated is
+        # len(prompt) + generated - 1; the last usable write position is
+        # max_seq - 2 (max_seq - 1 is the trash row), so finish once the
+        # sequence would need to write past it.
+        total_len = len(request.prompt_tokens) + request.generated
         finished = (token in request.eos_tokens
                     or request.generated >= request.max_new_tokens
-                    or self.lengths[request.slot] >= self.max_seq - 1)
+                    or total_len >= self.max_seq)
         if request.emit is not None:
             request.emit(request.request_id, token, finished)
         if finished:
@@ -244,7 +358,9 @@ class ContinuousBatcher:
             self.lengths[slot] = 0
             self.current[slot] = 0
             self.temperatures[slot] = 0.0
+            self._temps_dev = None
             self.decoding[slot] = False
+            self._active_dev = None
 
     # -- introspection -----------------------------------------------------
 
@@ -256,9 +372,16 @@ class ContinuousBatcher:
     def queue_depth(self) -> int:
         return len(self.pending)
 
+    @property
+    def blocks_in_flight(self) -> int:
+        """Dispatched-but-unretired fused decode blocks (pipelined
+        path); drive step() until this reaches 0 to drain them."""
+        return len(self._inflight)
+
     def run_until_drained(self, max_steps: int = 100_000) -> int:
         steps = 0
-        while (self.pending or self.active_count) and steps < max_steps:
+        while (self.pending or self.active_count or self._inflight) \
+                and steps < max_steps:
             self.step()
             steps += 1
         return steps

@@ -370,7 +370,8 @@ def select_tokens(key: jax.Array, logits: jax.Array,
 def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
                  cache: dict, lengths: jax.Array, active: jax.Array,
                  temperatures: jax.Array, key: jax.Array, *,
-                 num_steps: int) -> tuple[jax.Array, dict]:
+                 num_steps: int) \
+        -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, dict]:
     """``num_steps`` decode iterations fused into ONE dispatch
     (sampling included), amortizing the host round trip -- through a
     ~100 ms tunnel a per-step host loop is pure RTT; locally it still
@@ -379,16 +380,22 @@ def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
     tokens: [B] current tokens; lengths: [B] write positions of ACTIVE
     rows; active: [B] bool (inactive rows -- empty or mid-prefill slots
     -- write to the trash position T-1 every step, exactly like the
-    single-step batcher tick).  Returns (emitted [num_steps, B], cache);
-    the host discards a row's tail after its EOS / budget and frees the
-    slot -- the garbage KV written past that point sits beyond the
-    freed slot's next occupant's length mask.
+    single-step batcher tick).  Returns
+    ``(emitted [num_steps, B], tokens' [B], lengths' [B], key', cache)``
+    -- the final carries come back as DEVICE arrays so the batcher can
+    dispatch block k+1 from block k's outputs without a host round trip
+    (the in-flight pipelining the serving loop is built on); the host
+    discards a row's tail after its EOS / budget and frees the slot --
+    the garbage KV written past that point sits beyond the freed slot's
+    next occupant's length mask.  Write positions clamp to the trash
+    position so a speculative block dispatched near the cache boundary
+    can never scatter out of bounds.
     """
     trash = cache["k"].shape[2] - 1
 
     def body(carry, _):
         tokens, cache, lengths, key = carry
-        positions = jnp.where(active, lengths, trash)
+        positions = jnp.where(active, jnp.minimum(lengths, trash), trash)
         logits, cache = decode_step.__wrapped__(params, config, tokens,
                                                 cache, positions)
         key, sub = jax.random.split(key)
@@ -397,6 +404,6 @@ def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
         lengths = lengths + active.astype(lengths.dtype)
         return (tokens, cache, lengths, key), tokens
 
-    (_, cache, _, _), emitted = jax.lax.scan(
+    (tokens, cache, lengths, key), emitted = jax.lax.scan(
         body, (tokens, cache, lengths, key), None, length=num_steps)
-    return emitted, cache
+    return emitted, tokens, lengths, key, cache

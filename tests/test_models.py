@@ -279,10 +279,88 @@ def test_decode_block_matches_single_steps(tiny):
     assert [len(v) for v in blocked.values()] == [5, 9, 4]
 
 
+def test_pipelined_blocks_match_single_steps(tiny):
+    """The in-flight pipelined decode (inflight > 1, device-chained
+    dispatches) emits exactly the streams the synchronous single-step
+    batcher produces -- including a mid-stream admission into a freed
+    slot, an EOS cut mid-block, and queueing beyond max_slots."""
+    from aiko_services_tpu.models import ContinuousBatcher, Request
+    from aiko_services_tpu.models.tokenizer import ByteTokenizer
+
+    config, params = tiny
+    tok = ByteTokenizer()
+
+    def run(block, inflight):
+        out = {}
+        batcher = ContinuousBatcher(params, config, max_slots=2,
+                                    max_seq=64, prefill_chunk=16,
+                                    decode_block=block,
+                                    inflight=inflight)
+        for i, budget in enumerate((7, 18, 5, 11)):   # 4 reqs, 2 slots
+            batcher.submit(Request(
+                f"r{i}", tok.encode(f"pipelined prompt {i}"),
+                max_new_tokens=budget,
+                emit=lambda r, t, f: out.setdefault(r, []).append(
+                    (t, f))))
+        steps = batcher.run_until_drained(max_steps=500)
+        assert steps < 500
+        assert batcher.active_count == 0
+        assert not batcher._inflight
+        return out
+
+    reference = run(1, 1)
+    pipelined = run(4, 3)
+    assert reference == pipelined
+    assert [len(v) for v in pipelined.values()] == [7, 18, 5, 11]
+    for stream in pipelined.values():               # finished flags
+        assert stream[-1][1] is True
+        assert not any(f for _, f in stream[:-1])
+
+
+def test_pipelined_blocks_respect_eos(tiny):
+    """EOS inside an in-flight block truncates the stream and frees the
+    slot; speculative tokens already dispatched are discarded."""
+    from aiko_services_tpu.models import ContinuousBatcher, Request
+
+    config, params = tiny
+
+    def run(block, inflight):
+        out = []
+        batcher = ContinuousBatcher(params, config, max_slots=2,
+                                    max_seq=64, prefill_chunk=16,
+                                    decode_block=block,
+                                    inflight=inflight)
+        batcher.submit(Request(
+            "r", [1, 2, 3], max_new_tokens=40,
+            emit=lambda r, t, f: out.append((t, f))))
+        batcher.run_until_drained(max_steps=300)
+        return out
+
+    reference = run(1, 1)
+    eos = reference[4][0]       # make the 5th greedy token the EOS
+
+    def run_eos(block, inflight):
+        out = []
+        batcher = ContinuousBatcher(params, config, max_slots=2,
+                                    max_seq=64, prefill_chunk=16,
+                                    decode_block=block,
+                                    inflight=inflight)
+        batcher.submit(Request(
+            "r", [1, 2, 3], max_new_tokens=40, eos_tokens=(eos,),
+            emit=lambda r, t, f: out.append((t, f))))
+        batcher.run_until_drained(max_steps=300)
+        return out
+
+    expected = reference[:4] + [(eos, True)]
+    expected = [(t, i == 4) for i, (t, _) in enumerate(expected)]
+    assert run_eos(4, 3) == expected
+    assert run_eos(1, 1) == expected
+
+
 def test_decode_block_interleaves_with_admission(tiny):
     """A request submitted while a blocked decode is running still
-    admits (the batcher falls back to single ticks during prefill) and
-    both streams complete."""
+    admits (prefill chunks interleave between fused-block dispatches)
+    and both streams complete."""
     from aiko_services_tpu.models import ContinuousBatcher, Request
     from aiko_services_tpu.models.tokenizer import ByteTokenizer
 
