@@ -159,11 +159,17 @@ class ContinuousBatcher:
     def _prefill_tick(self):
         """Advance admissions by one chunk (<= prefill_chunk tokens)
         each.  Pipelined path (decode_block > 1): every admitting slot
-        advances -- the chunks are async dispatches chained on the
-        cache, so a burst costs device time, not host round trips.
+        advances -- a multi-slot burst runs as ONE batched dispatch
+        (``llama.prefill_into_slots``: the [N*S, dim] matmuls feed the
+        MXU far better than N serialized [S, dim] dispatches), falling
+        back to per-slot dispatches for the flash-attention config.
         Synchronous path (decode_block == 1): at most ONE chunk total,
         preserving the one-chunk decode-stall bound (each chunk's
         completion fetch blocks the host there)."""
+        if (self.decode_block > 1 and len(self._prefilling) > 1
+                and self.config.attention != "flash"):
+            self._prefill_tick_batched()
+            return
         budget = len(self._prefilling) if self.decode_block > 1 \
             else min(1, len(self._prefilling))
         for _ in range(budget):
@@ -171,50 +177,93 @@ class ContinuousBatcher:
             request = self.slots[slot]
             if request is None:                 # cancelled while waiting
                 continue
-            prompt = request.prompt_tokens
-            # Clamp the write start so a full chunk always fits inside
-            # the cache (a spilling dynamic_update_slice would clamp
-            # internally and corrupt earlier positions).  A clamped
-            # start re-writes the overlap with byte-identical KV (same
-            # tokens, same positions), so correctness is unaffected and
-            # only the final chunk pays.
-            start = min(request.prefill_pos,
-                        self.max_seq - self.prefill_chunk)
-            chunk_tokens = prompt[start:start + self.prefill_chunk]
-            # Always pad to the full chunk: one compiled shape for every
-            # admission.  Pad positions hold garbage KV, but decode
-            # writes each position before the length mask ever admits
-            # it, and the causal prefill mask never looks past the
-            # query position.
+            start, chunk_tokens = self._admission_chunk(request)
             padded = np.zeros((1, self.prefill_chunk), dtype=np.int32)
             padded[0, :len(chunk_tokens)] = chunk_tokens
             logits, self.cache = llama.prefill_into_slot(
                 self.params, self.config, jnp.asarray(padded),
                 self.cache, jnp.int32(slot), jnp.int32(start))
-            self.prefill_tokens += start + len(chunk_tokens) \
-                - request.prefill_pos
-            request.prefill_pos = start + len(chunk_tokens)
-            if request.prefill_pos < len(prompt):
-                self._prefilling.append(slot)   # more chunks to go
-                continue
-            # Final chunk: sample the first generated token from the
-            # last real prompt position's logits and hand the slot to
-            # decode.
-            last = len(prompt) - start - 1
-            first = self._sample(logits[:, last, :], request.temperature)
-            self.lengths[slot] = len(prompt)
-            self.decoding[slot] = True
-            self._active_dev = None
-            if self.decode_block > 1:
-                # Pipelined path: don't fetch (a tunnel round trip per
-                # admission) -- fold the device scalar into the next
-                # block dispatch and emit it when that block retires.
-                first.copy_to_host_async()
-                self._pending_first[slot] = (request, first)
-            else:
-                first_token = int(jax.device_get(first)[0])
-                self.current[slot] = first_token
-                self._emit(request, first_token)
+            self._admission_advance(slot, request, start,
+                                    len(chunk_tokens), logits)
+
+    def _prefill_tick_batched(self):
+        """One chunk for EVERY admitting slot in a single batched
+        dispatch.  N is padded up to a power-of-two compile bucket by
+        duplicating the first row (idempotent: same slot, same start,
+        same tokens -- see llama.prefill_into_slots)."""
+        admitting = []
+        for _ in range(len(self._prefilling)):
+            slot = self._prefilling.pop(0)
+            if self.slots[slot] is not None:    # else: cancelled
+                admitting.append(slot)
+        if not admitting:
+            return
+        n = len(admitting)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        rows = admitting + [admitting[0]] * (bucket - n)
+        tokens = np.zeros((bucket, self.prefill_chunk), dtype=np.int32)
+        slot_rows = np.zeros(bucket, dtype=np.int32)
+        starts = np.zeros(bucket, dtype=np.int32)
+        metas = []
+        for i, slot in enumerate(rows):
+            request = self.slots[slot]
+            start, chunk_tokens = self._admission_chunk(request)
+            tokens[i, :len(chunk_tokens)] = chunk_tokens
+            slot_rows[i] = slot
+            starts[i] = start
+            metas.append((slot, request, start, len(chunk_tokens)))
+        logits, self.cache = llama.prefill_into_slots(
+            self.params, self.config, jnp.asarray(tokens), self.cache,
+            jnp.asarray(slot_rows), jnp.asarray(starts))
+        for i, (slot, request, start, chunk_len) in enumerate(metas[:n]):
+            self._admission_advance(slot, request, start, chunk_len,
+                                    logits[i:i + 1])
+
+    def _admission_chunk(self, request: Request):
+        """(start, chunk tokens) of the request's next prefill chunk.
+        The write start clamps so a full chunk always fits inside the
+        cache (a spilling dynamic_update_slice would clamp internally
+        and corrupt earlier positions); a clamped start re-writes the
+        overlap with byte-identical KV (same tokens, same positions), so
+        correctness is unaffected and only the final chunk pays.  The
+        chunk is always PADDED to prefill_chunk by the caller: one
+        compiled shape per admission; pad positions hold garbage KV, but
+        decode writes each position before the length mask ever admits
+        it, and the causal prefill mask never looks past the query
+        position."""
+        start = min(request.prefill_pos,
+                    self.max_seq - self.prefill_chunk)
+        return start, request.prompt_tokens[
+            start:start + self.prefill_chunk]
+
+    def _admission_advance(self, slot: int, request: Request,
+                           start: int, chunk_len: int, logits):
+        """Account one written chunk; on the FINAL chunk, sample the
+        first generated token from the last real prompt position's
+        logits ([1, S, vocab] row) and hand the slot to decode --
+        without fetching on the pipelined path (the device scalar folds
+        into the next block dispatch and emits when that block
+        retires)."""
+        prompt = request.prompt_tokens
+        self.prefill_tokens += start + chunk_len - request.prefill_pos
+        request.prefill_pos = start + chunk_len
+        if request.prefill_pos < len(prompt):
+            self._prefilling.append(slot)       # more chunks to go
+            return
+        last = len(prompt) - start - 1
+        first = self._sample(logits[:, last, :], request.temperature)
+        self.lengths[slot] = len(prompt)
+        self.decoding[slot] = True
+        self._active_dev = None
+        if self.decode_block > 1:
+            first.copy_to_host_async()
+            self._pending_first[slot] = (request, first)
+        else:
+            first_token = int(jax.device_get(first)[0])
+            self.current[slot] = first_token
+            self._emit(request, first_token)
 
     # -- decode ------------------------------------------------------------
 

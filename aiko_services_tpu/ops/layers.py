@@ -114,35 +114,56 @@ def attention_decode_append(q: jax.Array, k_cache: jax.Array,
     full-cache rewrite (536 MB/step at llama3-1b/2k) disappears, and the
     single post-scan scatter aliases in place under jit donation.
 
+    TPU layout: the cache is consumed as [B, T, K*hd] -- its natural
+    contiguous view -- and GQA is expressed as BLOCK-DIAGONAL matmuls
+    over the fused K*hd axis: each query head is zero-padded to the full
+    K*hd width with its values in its own kv head's block, so
+    ``scores = q_pad @ k_flat^T`` contracts over K*hd (a multiple of the
+    128-wide vector lanes) and the weighted sum is a plain
+    ``[H, T] @ [T, K*hd]`` matmul.  A per-head grouped einsum instead
+    contracts over hd=64 against a [B, T, K, hd] operand -- half-empty
+    lanes and either a strided read or a full-cache transpose; measured
+    on v5e this trick takes the per-step attention cost from ~1.9 ms to
+    the cache-streaming floor.  The extra multiply-by-zero FLOPs are
+    free: decode runs at ~2% MFU, bandwidth-bound.
+
     q: [B, 1, H, hd]; k_cache/v_cache: [B, T, K, hd] (grouped); k_new/
     v_new: [B, 1, K, hd]; lengths: [B] valid cache positions (NOT
     counting the current token).  Returns [B, 1, H, hd].
     """
-    scale = q.shape[-1] ** -0.5
-    grouped = _group_queries(q, k_cache.shape[2])  # [B,1,K,G,hd]
-    cache_logits = jnp.einsum("bskgd,btkd->bkgst", grouped, k_cache,
-                              preferred_element_type=jnp.float32) * scale
-    t = k_cache.shape[1]
-    valid = jnp.arange(t)[None, None, None, None, :] < \
-        lengths[:, None, None, None, None]
+    b, _, h, d = q.shape
+    t, kv = k_cache.shape[1], k_cache.shape[2]
+    scale = d ** -0.5
+    blocks = jnp.arange(h) // (h // kv)            # [H] kv head per head
+    onehot = jax.nn.one_hot(blocks, kv, dtype=q.dtype)       # [H, K]
+    q_flat = q[:, 0]                                         # [B, H, hd]
+    q_pad = jnp.einsum("bhd,hk->bhkd", q_flat, onehot) \
+        .reshape(b, h, kv * d)                               # [B, H, K*hd]
+    k_flat = k_cache.reshape(b, t, kv * d)
+    v_flat = v_cache.reshape(b, t, kv * d)
+    cache_logits = jnp.einsum(
+        "bhc,btc->bht", q_pad, k_flat,
+        preferred_element_type=jnp.float32) * scale          # [B, H, T]
+    valid = jnp.arange(t)[None, None, :] < lengths[:, None, None]
     cache_logits = jnp.where(valid, cache_logits, -1e30)
-    self_logits = jnp.einsum("bskgd,btkd->bkgst", grouped, k_new,
-                             preferred_element_type=jnp.float32) * scale
-    peak = jnp.maximum(jnp.max(cache_logits, axis=-1, keepdims=True),
-                       self_logits)                # [B,K,G,1,1]
-    cache_weights = jnp.exp(cache_logits - peak)   # [B,K,G,1,T]
-    self_weights = jnp.exp(self_logits - peak)     # [B,K,G,1,1]
-    denominator = (jnp.sum(cache_weights, axis=-1, keepdims=True)
-                   + self_weights)                 # [B,K,G,1,1]
-    cache_part = jnp.einsum(                       # -> [B,1,K,G,hd] f32
-        "bkgst,btkd->bskgd", cache_weights.astype(v_cache.dtype),
-        v_cache, preferred_element_type=jnp.float32)
-    # [B,K,G,1,1] -> [B,1,K,G,1] to broadcast against [B,1,K,1,hd].
-    w_self = self_weights[:, :, :, 0, 0][:, None, :, :, None]
-    denom = denominator[:, :, :, 0, 0][:, None, :, :, None]
+    k_new_h = k_new[:, 0][:, blocks, :]            # [B, H, hd] gathered
+    v_new_h = v_new[:, 0][:, blocks, :]
+    self_logits = (q_flat.astype(jnp.float32)
+                   * k_new_h.astype(jnp.float32)).sum(-1) * scale  # [B,H]
+    peak = jnp.maximum(jnp.max(cache_logits, axis=-1), self_logits)
+    cache_weights = jnp.exp(cache_logits - peak[:, :, None])  # [B,H,T]
+    self_weights = jnp.exp(self_logits - peak)                # [B,H]
+    denominator = cache_weights.sum(-1) + self_weights        # [B,H]
+    fused = jnp.einsum(
+        "bht,btc->bhc", cache_weights.astype(v_cache.dtype), v_flat,
+        preferred_element_type=jnp.float32)                   # [B,H,K*hd]
+    # Select each head's own block back out of the fused output.
+    cache_part = jnp.einsum("bhkd,hk->bhd",
+                            fused.reshape(b, h, kv, d),
+                            onehot.astype(jnp.float32))       # [B,H,hd]
     out = (cache_part
-           + w_self * v_new[:, :, :, None, :].astype(jnp.float32)) \
-        / denom
+           + self_weights[:, :, None] * v_new_h.astype(jnp.float32)) \
+        / denominator[:, :, None]
     return out.reshape(q.shape).astype(q.dtype)
 
 

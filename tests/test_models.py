@@ -317,6 +317,32 @@ def test_pipelined_blocks_match_single_steps(tiny):
         assert not any(f for _, f in stream[:-1])
 
 
+def test_batched_admission_matches_single(tiny):
+    """A burst of admissions with very different prompt lengths (1 to
+    3 chunks each, batched multi-slot prefill + power-of-two padding)
+    emits exactly what one-at-a-time synchronous admission emits."""
+    from aiko_services_tpu.models import ContinuousBatcher, Request
+
+    config, params = tiny
+    prompts = [[1, 2, 3], list(range(1, 41)), list(range(5, 22)),
+               [7], list(range(3, 36))]          # 5 requests, 4 slots
+
+    def run(block, inflight):
+        out = {}
+        batcher = ContinuousBatcher(params, config, max_slots=4,
+                                    max_seq=64, prefill_chunk=16,
+                                    decode_block=block,
+                                    inflight=inflight)
+        for i, prompt in enumerate(prompts):
+            batcher.submit(Request(
+                f"r{i}", list(prompt), max_new_tokens=6,
+                emit=lambda r, t, f: out.setdefault(r, []).append(t)))
+        assert batcher.run_until_drained(max_steps=400) < 400
+        return out
+
+    assert run(1, 1) == run(4, 3)
+
+
 def test_pipelined_blocks_respect_eos(tiny):
     """EOS inside an in-flight block truncates the stream and frees the
     slot; speculative tokens already dispatched are discarded."""
