@@ -86,7 +86,8 @@ class ContinuousBatcher:
     def __init__(self, params, config: llama.LlamaConfig,
                  max_slots: int = 8, max_seq: int | None = None,
                  prefill_chunk: int = 512, rng_seed: int = 0,
-                 decode_block: int = 1, inflight: int = 2):
+                 decode_block: int = 1, inflight: int = 2,
+                 cache_put: Callable | None = None):
         self.params = params
         self.config = config
         self.max_slots = max_slots
@@ -103,6 +104,15 @@ class ContinuousBatcher:
         # round-trip latency behind device work.
         self.inflight = max(1, int(inflight))
         self.cache = llama.init_cache(config, max_slots, self.max_seq)
+        # Multichip serving: ``cache_put`` places the initial KV cache
+        # onto the serving mesh (e.g. ``lambda c: plan.put(c,
+        # llama.cache_specs(config))`` for TP-sharded kv heads) --
+        # donation keeps that sharding across every subsequent dispatch,
+        # so one placement at init is enough.  Params are pre-sharded by
+        # the caller the same way (quantized trees via
+        # quant.quantize_specs).
+        if cache_put is not None:
+            self.cache = cache_put(self.cache)
         self.lengths = np.zeros(max_slots, dtype=np.int32)
         self.current = np.zeros(max_slots, dtype=np.int32)
         self.temperatures = np.zeros(max_slots, dtype=np.float32)

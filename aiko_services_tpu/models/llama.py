@@ -22,12 +22,12 @@ import jax.numpy as jnp
 from ..ops.layers import (rms_norm, rope_frequencies, apply_rope,
                           attention_prefill, attention_decode_append)
 from ..parallel.mesh import P
-from .quant import is_quantized
+from .quant import dequantize_kv, is_quantized, quantize_kv
 
 __all__ = ["LlamaConfig", "init_params", "partition_specs",
-           "cache_specs", "init_cache", "prefill", "prefill_into_slot",
-           "prefill_into_slots", "decode_step", "decode_block",
-           "greedy_sample", "select_tokens"]
+           "cache_specs", "init_cache", "cache_array", "prefill",
+           "prefill_into_slot", "prefill_into_slots", "decode_step",
+           "decode_block", "greedy_sample", "select_tokens"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,12 +48,22 @@ class LlamaConfig:
     # serving path).  Applies to prefill_into_slot, the continuous
     # batcher's admission path; decode is O(1)-query and stays dense.
     attention: str = "dense"
+    # KV cache storage: "bfloat16" or "int8" (per-token-per-head scales,
+    # models/quant.py:quantize_kv).  Decode streams the whole cache every
+    # step, so at long context the cache -- not the weights -- dominates
+    # the HBM bytes; int8 halves them.  Composes with weight-only int8
+    # and with the TP/dp cache sharding (cache_specs).
+    kv_dtype: str = "bfloat16"
 
     def __post_init__(self):
         if self.attention not in ("dense", "flash"):
             raise ValueError(
                 f"attention must be 'dense' or 'flash', "
                 f"got {self.attention!r}")
+        if self.kv_dtype not in ("bfloat16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bfloat16' or 'int8', "
+                f"got {self.kv_dtype!r}")
 
     @property
     def head_dim(self) -> int:
@@ -141,10 +151,16 @@ def partition_specs(config: LlamaConfig) -> dict:
     }
 
 
-def cache_specs() -> dict:
-    """KV cache: batch over dp, kv heads over tp."""
-    return {"k": P(None, "dp", None, "tp", None),
-            "v": P(None, "dp", None, "tp", None)}
+def cache_specs(config: LlamaConfig | None = None) -> dict:
+    """KV cache: batch over dp, kv heads over tp.  For an int8 cache
+    (config.kv_dtype) the spec tree mirrors the quantized layer
+    structure; the scale ([L, B, T, K, 1]) shards identically -- its
+    kv-head axis lives on the same chips as the payload's."""
+    spec = P(None, "dp", None, "tp", None)
+    if config is not None and config.kv_dtype == "int8":
+        leaf = {"int8": spec, "scale": spec}
+        return {"k": leaf, "v": leaf}
+    return {"k": spec, "v": spec}
 
 
 def init_cache(config: LlamaConfig, batch: int,
@@ -152,8 +168,42 @@ def init_cache(config: LlamaConfig, batch: int,
     c = config
     t = max_seq or c.max_seq
     shape = (c.n_layers, batch, t, c.n_kv_heads, c.head_dim)
+    if c.kv_dtype == "int8":
+        def layer():
+            return {"int8": jnp.zeros(shape, dtype=jnp.int8),
+                    "scale": jnp.zeros(shape[:-1] + (1,),
+                                       dtype=jnp.float32)}
+        return {"k": layer(), "v": layer()}
     return {"k": jnp.zeros(shape, dtype=_dtype(c)),
             "v": jnp.zeros(shape, dtype=_dtype(c))}
+
+
+def _kv_store(layer, new, write):
+    """Write raw k/v values ``new`` into a cache layer via
+    ``write(old_array, new_array) -> updated`` -- quantizing first when
+    the layer is an int8 cache leaf (the same positional write then
+    applies to the payload and to the scale, whose trailing axis is
+    size 1)."""
+    if is_quantized(layer):
+        q = quantize_kv(new)
+        return {"int8": write(layer["int8"], q["int8"]),
+                "scale": write(layer["scale"], q["scale"])}
+    return write(layer, new)
+
+
+def _kv_rows(layer, slice_fn):
+    """Apply a row-slicing fn to each stored array of a cache layer."""
+    if is_quantized(layer):
+        return {"int8": slice_fn(layer["int8"]),
+                "scale": slice_fn(layer["scale"])}
+    return slice_fn(layer)
+
+
+def cache_array(cache: dict):
+    """The cache's key payload array (shape introspection that works
+    for bf16 and int8 caches alike)."""
+    k = cache["k"]
+    return k["int8"] if is_quantized(k) else k
 
 
 def matmul(x, w):
@@ -247,8 +297,11 @@ def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
             k = apply_rope(k, rope_table, positions)
             # scatter chunk into the cache at [b, start+i]
             batch_index = jnp.arange(b)[:, None]
-            k_layer2 = k_layer.at[batch_index, positions].set(k)
-            v_layer2 = v_layer.at[batch_index, positions].set(v)
+
+            def write(old, new):
+                return old.at[batch_index, positions].set(new)
+            k_layer2 = _kv_store(k_layer, k, write)
+            v_layer2 = _kv_store(v_layer, v, write)
             kv_write.updated = (k_layer2, v_layer2)
             # Grouped cache consumed directly (attention_prefill groups
             # the queries): no repeat_kv materialization.
@@ -283,19 +336,29 @@ def prefill_into_slot(params: dict, config: LlamaConfig,
         def kv_write(q, k, v):
             q = apply_rope(q, rope_table, positions)
             k = apply_rope(k, rope_table, positions)
-            k_layer2 = jax.lax.dynamic_update_slice(
-                k_layer, k, (slot, start, 0, 0))
-            v_layer2 = jax.lax.dynamic_update_slice(
-                v_layer, v, (slot, start, 0, 0))
+
+            def write(old, new):
+                return jax.lax.dynamic_update_slice(
+                    old, new, (slot, start, 0, 0))
+
+            def row(arr):
+                return jax.lax.dynamic_slice(
+                    arr, (slot, 0, 0, 0), (1,) + arr.shape[1:])
+            k_layer2 = _kv_store(k_layer, k, write)
+            v_layer2 = _kv_store(v_layer, v, write)
             kv_write.updated = (k_layer2, v_layer2)
-            k_row = jax.lax.dynamic_slice(
-                k_layer2, (slot, 0, 0, 0), (1,) + k_layer.shape[1:])
-            v_row = jax.lax.dynamic_slice(
-                v_layer2, (slot, 0, 0, 0), (1,) + v_layer.shape[1:])
+            k_row = _kv_rows(k_layer2, row)
+            v_row = _kv_rows(v_layer2, row)
             if c.attention == "flash":
                 # Causality from the traced chunk offset covers both
                 # intra-chunk masking and the unwritten cache tail.
+                # The kernel reads bf16; an int8 cache row is
+                # dequantized here (admission is compute-bound -- the
+                # byte saving matters in decode, which never does this).
                 from ..ops.pallas_attention import flash_attention
+                if is_quantized(k_row):
+                    k_row = dequantize_kv(k_row, q.dtype)
+                    v_row = dequantize_kv(v_row, q.dtype)
                 return flash_attention(q, k_row, v_row, q_offset=start)
             return attention_prefill(q, k_row, v_row, positions)
         return kv_write
@@ -334,22 +397,26 @@ def prefill_into_slots(params: dict, config: LlamaConfig,
         def kv_write(q, k, v):
             q = apply_rope(q, rope_table, positions)
             k = apply_rope(k, rope_table, positions)
-            k_l, v_l = k_layer, v_layer
-            # Unrolled per-row DUS (in-place under donation; a batched
-            # scatter would copy the cache -- see decode_step).
-            for i in range(n):
-                at = (slots[i], starts[i], 0, 0)
-                k_l = jax.lax.dynamic_update_slice(k_l, k[i:i + 1], at)
-                v_l = jax.lax.dynamic_update_slice(v_l, v[i:i + 1], at)
+
+            def write_rows(old, new):
+                # Unrolled per-row DUS (in-place under donation; a
+                # batched scatter would copy the cache -- see
+                # decode_step).
+                for i in range(n):
+                    old = jax.lax.dynamic_update_slice(
+                        old, new[i:i + 1], (slots[i], starts[i], 0, 0))
+                return old
+
+            def gather_rows(arr):
+                return jnp.concatenate(
+                    [jax.lax.dynamic_slice(arr, (slots[i], 0, 0, 0),
+                                           (1,) + arr.shape[1:])
+                     for i in range(n)])                     # [N,T,K,*]
+            k_l = _kv_store(k_layer, k, write_rows)
+            v_l = _kv_store(v_layer, v, write_rows)
             kv_write.updated = (k_l, v_l)
-            k_rows = jnp.concatenate(
-                [jax.lax.dynamic_slice(k_l, (slots[i], 0, 0, 0),
-                                       (1,) + k_l.shape[1:])
-                 for i in range(n)])                         # [N,T,K,hd]
-            v_rows = jnp.concatenate(
-                [jax.lax.dynamic_slice(v_l, (slots[i], 0, 0, 0),
-                                       (1,) + v_l.shape[1:])
-                 for i in range(n)])
+            k_rows = _kv_rows(k_l, gather_rows)
+            v_rows = _kv_rows(v_l, gather_rows)
             return attention_prefill(q, k_rows, v_rows, positions)
         return kv_write
 
@@ -392,14 +459,17 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
         # unrolled DUS chain updates in place.  b is a static trace-time
         # constant (the slot count), so the unroll is bounded.
         k_tokens, v_tokens = updates               # [L, B, 1, K, hd]
-        k_cache, v_cache = cache["k"], cache["v"]
-        for row in range(b):
-            start = (0, row, lengths[row], 0, 0)
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k_tokens[:, row][:, None], start)
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v_tokens[:, row][:, None], start)
-        return {"k": k_cache, "v": v_cache}
+
+        def scatter(layer, tokens):
+            def write(old, new):
+                for row in range(b):
+                    old = jax.lax.dynamic_update_slice(
+                        old, new[:, row][:, None],
+                        (0, row, lengths[row], 0, 0))
+                return old
+            return _kv_store(layer, tokens, write)
+        return {"k": scatter(cache["k"], k_tokens),
+                "v": scatter(cache["v"], v_tokens)}
 
     logits, new_cache = _forward_layers(
         params, c, params["embed"][tokens][:, None, :], cache, factory,
@@ -454,7 +524,7 @@ def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
     position so a speculative block dispatched near the cache boundary
     can never scatter out of bounds.
     """
-    trash = cache["k"].shape[2] - 1
+    trash = cache_array(cache).shape[2] - 1
 
     def body(carry, _):
         tokens, cache, lengths, key = carry

@@ -9,9 +9,12 @@ convert fuses into the matmul's operand load on TPU (measured 1.8x on
 the weight-bound matmul shape, v5e), and the per-channel scale applies
 AFTER the dot so no dequantized weight tensor ever exists in HBM.
 
-Activations, norms, embeddings and the KV cache stay bfloat16 --
-weight-only quantization is the standard quality/speed point for
-serving (per-channel error ~0.3% of weight magnitude).
+Activations, norms and embeddings stay bfloat16 -- weight-only
+quantization is the standard quality/speed point for serving
+(per-channel error ~0.3% of weight magnitude).  The KV cache has its
+own int8 mode (``LlamaConfig(kv_dtype="int8")``, per-token-per-head
+scales over head_dim) for long-context serving, where the cache --
+not the weights -- dominates the decode byte stream.
 
 Usage::
 
@@ -29,7 +32,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["quantize_weight", "quantize_params", "is_quantized"]
+from ..parallel.mesh import P
+
+__all__ = ["quantize_weight", "quantize_params", "quantize_specs",
+           "quantize_kv", "dequantize_kv", "is_quantized"]
 
 # The layer-stacked matmul weights + the unembed projection; embeddings
 # (gather, not matmul) and norm vectors stay bf16.
@@ -53,19 +59,69 @@ def is_quantized(leaf) -> bool:
     return isinstance(leaf, dict) and "int8" in leaf and "scale" in leaf
 
 
+def quantize_kv(x) -> dict:
+    """KV-cache quantization: symmetric int8 over the trailing head_dim
+    with one float32 scale per (position, kv-head) -- ``[..., hd]`` ->
+    ``{"int8": [..., hd], "scale": [..., 1]}``.
+
+    Decode streams the whole cache every step; int8 halves those bytes
+    (the scale adds 1/head_dim).  The scale never enters the attention
+    matmuls: the score matmul contracts int8-cast-to-bf16 keys and the
+    per-position key scale multiplies the [B, H, T] logits afterwards,
+    and the value scale folds into the softmax weights before the
+    weighted sum -- exact, because each scale is constant along the
+    contracted head_dim axis (see ops/layers.py attention paths)."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.abs(x32).max(axis=-1, keepdims=True),
+                        1e-8) / 127.0
+    quantized = jnp.clip(jnp.round(x32 / scale), -127, 127)
+    return {"int8": quantized.astype(jnp.int8), "scale": scale}
+
+
+def dequantize_kv(leaf: dict, dtype) -> "jnp.ndarray":
+    """Materialize a quantized KV layer back to ``dtype`` (the flash
+    kernel's admission path; decode never materializes this)."""
+    return leaf["int8"].astype(dtype) * leaf["scale"].astype(dtype)
+
+
 def quantize_params(params: dict) -> dict:
     """Quantize a llama parameter tree (models/llama.py:init_params
-    layout) for weight-only int8 serving.
-
-    Single-host serving only for now: the quantized tree's structure
-    (dict leaves) does not match ``llama.partition_specs``, so it cannot
-    be sharded with the TP/fsdp layout -- extend partition_specs (int8
-    inheriting the weight's spec, scale sharded on the output axis)
-    before composing with the multichip paths."""
+    layout) for weight-only int8 serving.  Composes with the multichip
+    paths: :func:`quantize_specs` maps ``llama.partition_specs`` onto
+    the quantized tree's structure, so TP/fsdp serving shards the
+    int8 tree exactly like the bf16 one."""
     layers = dict(params["layers"])
     for key in QUANTIZED_LAYER_KEYS:
         layers[key] = quantize_weight(layers[key])
     quantized = dict(params)
     quantized["layers"] = layers
     quantized["unembed"] = quantize_weight(params["unembed"])
+    return quantized
+
+
+def quantize_specs(specs: dict) -> dict:
+    """Map a ``llama.partition_specs`` tree onto the structure of a
+    :func:`quantize_params` tree: each quantized leaf becomes
+    ``{"int8": <weight's spec>, "scale": <spec with the contraction
+    axis unsharded>}``.
+
+    The int8 tensor has the weight's exact shape, so it inherits the
+    weight's spec unchanged; the scale is ``[..., 1, F]`` -- size 1 on
+    the contraction axis (it cannot shard there) and the weight's own
+    layout on the output axis, so per-output-channel scales land on the
+    same chips as the output channels they rescale and TP needs no
+    scale collectives."""
+    def scale_spec(spec: P) -> P:
+        entries = list(spec)
+        entries[-2] = None
+        return P(*entries)
+
+    layers = dict(specs["layers"])
+    for key in QUANTIZED_LAYER_KEYS:
+        layers[key] = {"int8": layers[key],
+                       "scale": scale_spec(layers[key])}
+    quantized = dict(specs)
+    quantized["layers"] = layers
+    quantized["unembed"] = {"int8": specs["unembed"],
+                            "scale": scale_spec(specs["unembed"])}
     return quantized

@@ -71,6 +71,25 @@ def _group_queries(q: jax.Array, kv_heads: int):
     return q.reshape(b, s, kv_heads, h // kv_heads, d)
 
 
+def _is_quantized_kv(layer) -> bool:
+    return isinstance(layer, dict) and "int8" in layer and "scale" in layer
+
+
+def _split_kv(layer, compute_dtype):
+    """(values-as-compute-dtype, per-position scale [B, T, K] or None).
+
+    Quantized layers (models/quant.py:quantize_kv) come apart into the
+    int8 payload cast to the compute dtype -- the convert fuses into the
+    attention matmul's operand load, so HBM streams int8 bytes -- and
+    the float32 scale, which the caller applies OUTSIDE the matmuls
+    (to score logits for keys, to softmax weights for values): exact,
+    since each scale is constant along the contracted head_dim."""
+    if _is_quantized_kv(layer):
+        return (layer["int8"].astype(compute_dtype),
+                layer["scale"][..., 0].astype(jnp.float32))
+    return layer, None
+
+
 def attention_prefill(q: jax.Array, k: jax.Array, v: jax.Array,
                       q_positions: jax.Array,
                       kv_length_mask: jax.Array | None = None) -> jax.Array:
@@ -84,11 +103,21 @@ def attention_prefill(q: jax.Array, k: jax.Array, v: jax.Array,
     positions of the queries (so chunked prefill against a longer cache
     works); kv_length_mask: [B, T] bool of valid cache slots.  float32
     softmax.
+
+    k/v may be int8-quantized cache layers (``{"int8", "scale"}``,
+    models/quant.py:quantize_kv): key scales multiply the score logits,
+    value scales fold into the softmax weights -- exact (scales are
+    constant along the contracted head_dim), and no dequantized cache
+    tensor ever reaches HBM.
     """
+    k, k_scale = _split_kv(k, q.dtype)
+    v, v_scale = _split_kv(v, q.dtype)
     scale = q.shape[-1] ** -0.5
     grouped = _group_queries(q, k.shape[2])        # [B,S,K,G,hd]
     logits = jnp.einsum("bskgd,btkd->bkgst", grouped, k,
                         preferred_element_type=jnp.float32) * scale
+    if k_scale is not None:                        # [B,T,K] -> [B,K,1,1,T]
+        logits = logits * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     t = k.shape[1]
     kv_positions = jnp.arange(t)[None, None, None, None, :]  # [1,1,1,1,T]
     causal = kv_positions <= \
@@ -98,6 +127,8 @@ def attention_prefill(q: jax.Array, k: jax.Array, v: jax.Array,
             causal, kv_length_mask[:, None, None, None, :])
     logits = jnp.where(causal, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1)
+    if v_scale is not None:
+        weights = weights * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
     out = jnp.einsum("bkgst,btkd->bskgd", weights.astype(v.dtype), v)
     return out.reshape(q.shape)
 
@@ -127,11 +158,19 @@ def attention_decode_append(q: jax.Array, k_cache: jax.Array,
     the cache-streaming floor.  The extra multiply-by-zero FLOPs are
     free: decode runs at ~2% MFU, bandwidth-bound.
 
-    q: [B, 1, H, hd]; k_cache/v_cache: [B, T, K, hd] (grouped); k_new/
+    q: [B, 1, H, hd]; k_cache/v_cache: [B, T, K, hd] (grouped) -- or
+    int8-quantized layers (``{"int8", "scale"}``): the cache matmuls
+    contract the int8 payload cast in-flight to the compute dtype
+    (streaming half the bytes), the key scales multiply the [B, H, T]
+    logits, and the value scales fold into the softmax weights before
+    the weighted sum -- both exact, since each (position, kv-head)
+    scale is constant along the contracted axes; k_new/
     v_new: [B, 1, K, hd]; lengths: [B] valid cache positions (NOT
     counting the current token).  Returns [B, 1, H, hd].
     """
     b, _, h, d = q.shape
+    k_cache, k_scale = _split_kv(k_cache, q.dtype)           # [B,T,K]
+    v_cache, v_scale = _split_kv(v_cache, q.dtype)
     t, kv = k_cache.shape[1], k_cache.shape[2]
     scale = d ** -0.5
     blocks = jnp.arange(h) // (h // kv)            # [H] kv head per head
@@ -144,6 +183,9 @@ def attention_decode_append(q: jax.Array, k_cache: jax.Array,
     cache_logits = jnp.einsum(
         "bhc,btc->bht", q_pad, k_flat,
         preferred_element_type=jnp.float32) * scale          # [B, H, T]
+    if k_scale is not None:      # [B,T,K] -> per-head [B,H,T] logit scale
+        cache_logits = cache_logits \
+            * k_scale.transpose(0, 2, 1)[:, blocks, :]
     valid = jnp.arange(t)[None, None, :] < lengths[:, None, None]
     cache_logits = jnp.where(valid, cache_logits, -1e30)
     k_new_h = k_new[:, 0][:, blocks, :]            # [B, H, hd] gathered
@@ -154,6 +196,12 @@ def attention_decode_append(q: jax.Array, k_cache: jax.Array,
     cache_weights = jnp.exp(cache_logits - peak[:, :, None])  # [B,H,T]
     self_weights = jnp.exp(self_logits - peak)                # [B,H]
     denominator = cache_weights.sum(-1) + self_weights        # [B,H]
+    if v_scale is not None:      # fold value scales into the weights:
+        # head h only reads its own kv block out of `fused` below, so
+        # scaling its weights by that block's per-position scale is
+        # exactly dequantization.
+        cache_weights = cache_weights \
+            * v_scale.transpose(0, 2, 1)[:, blocks, :]
     fused = jnp.einsum(
         "bht,btc->bhc", cache_weights.astype(v_cache.dtype), v_flat,
         preferred_element_type=jnp.float32)                   # [B,H,K*hd]
