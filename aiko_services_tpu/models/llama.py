@@ -26,8 +26,9 @@ from .quant import dequantize_kv, is_quantized, quantize_kv
 
 __all__ = ["LlamaConfig", "init_params", "partition_specs",
            "cache_specs", "init_cache", "cache_array", "prefill",
-           "prefill_into_slot", "prefill_into_slots", "decode_step",
-           "decode_block", "greedy_sample", "select_tokens"]
+           "prefill_with_aux", "prefill_into_slot",
+           "prefill_into_slots", "decode_step", "decode_block",
+           "greedy_sample", "select_tokens"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +55,17 @@ class LlamaConfig:
     # the HBM bytes; int8 halves them.  Composes with weight-only int8
     # and with the TP/dp cache sharding (cache_specs).
     kv_dtype: str = "bfloat16"
+    # Mixture-of-experts FFN (SURVEY §2.5: EP is a first-class axis of
+    # the TPU build; the reference has no parallelism at all).  0 =
+    # dense FFN; > 0 replaces every block's FFN with n_experts
+    # independent SwiGLU experts, top-k routed per token, expert
+    # weights sharded over the mesh's ``ep`` axis (partition_specs).
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+    # Static per-expert token buffer = capacity_factor x the perfectly
+    # balanced share; overflow tokens fall back to their residual
+    # stream (standard GShard semantics, keeps every shape static).
+    capacity_factor: float = 2.0
 
     def __post_init__(self):
         if self.attention not in ("dense", "flash"):
@@ -64,6 +76,18 @@ class LlamaConfig:
             raise ValueError(
                 f"kv_dtype must be 'bfloat16' or 'int8', "
                 f"got {self.kv_dtype!r}")
+        if self.n_experts and self.n_experts_per_token > self.n_experts:
+            raise ValueError(
+                f"n_experts_per_token ({self.n_experts_per_token}) "
+                f"exceeds n_experts ({self.n_experts})")
+
+    def moe_capacity(self, n_tokens: int) -> int:
+        """Static per-expert buffer size for ``n_tokens`` routed
+        tokens, rounded up to the 8-sublane TPU tile."""
+        import math
+        exact = math.ceil(self.capacity_factor * n_tokens
+                          * self.n_experts_per_token / self.n_experts)
+        return max(1, min(-(-exact // 8) * 8, n_tokens))
 
     @property
     def head_dim(self) -> int:
@@ -90,6 +114,14 @@ class LlamaConfig:
                    n_kv_heads=2, hidden_dim=128, max_seq=max_seq,
                    rope_theta=10_000.0)
 
+    @classmethod
+    def tiny_moe(cls, vocab_size: int = 512, max_seq: int = 256,
+                 n_experts: int = 4) -> "LlamaConfig":
+        """Test-size MoE config (4 experts, top-2 routing)."""
+        return cls(vocab_size=vocab_size, dim=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, hidden_dim=128, max_seq=max_seq,
+                   rope_theta=10_000.0, n_experts=n_experts)
+
 
 def _dtype(config: LlamaConfig):
     return jnp.dtype(config.dtype)
@@ -105,6 +137,27 @@ def init_params(key: jax.Array, config: LlamaConfig) -> dict:
         return (jax.random.normal(k, shape, dtype=jnp.float32)
                 * (fan_in ** -0.5)).astype(dtype)
 
+    if c.n_experts:
+        ffn = {
+            "w_router": dense(jax.random.fold_in(keys[5], 1),
+                              (c.n_layers, c.dim, c.n_experts), c.dim),
+            "w_gate": dense(keys[5], (c.n_layers, c.n_experts, c.dim,
+                                      c.hidden_dim), c.dim),
+            "w_up": dense(keys[6], (c.n_layers, c.n_experts, c.dim,
+                                    c.hidden_dim), c.dim),
+            "w_down": dense(keys[7], (c.n_layers, c.n_experts,
+                                      c.hidden_dim, c.dim),
+                            c.hidden_dim),
+        }
+    else:
+        ffn = {
+            "w_gate": dense(keys[5], (c.n_layers, c.dim, c.hidden_dim),
+                            c.dim),
+            "w_up": dense(keys[6], (c.n_layers, c.dim, c.hidden_dim),
+                          c.dim),
+            "w_down": dense(keys[7], (c.n_layers, c.hidden_dim, c.dim),
+                            c.hidden_dim),
+        }
     return {
         "embed": dense(keys[0], (c.vocab_size, c.dim), c.dim),
         "layers": {
@@ -116,12 +169,7 @@ def init_params(key: jax.Array, config: LlamaConfig) -> dict:
                         c.dim),
             "wo": dense(keys[4], (c.n_layers, c.n_heads * hd, c.dim),
                         c.n_heads * hd),
-            "w_gate": dense(keys[5], (c.n_layers, c.dim, c.hidden_dim),
-                            c.dim),
-            "w_up": dense(keys[6], (c.n_layers, c.dim, c.hidden_dim),
-                          c.dim),
-            "w_down": dense(keys[7], (c.n_layers, c.hidden_dim, c.dim),
-                            c.hidden_dim),
+            **ffn,
             "attn_norm": jnp.ones((c.n_layers, c.dim), dtype=dtype),
             "mlp_norm": jnp.ones((c.n_layers, c.dim), dtype=dtype),
         },
@@ -132,7 +180,24 @@ def init_params(key: jax.Array, config: LlamaConfig) -> dict:
 
 
 def partition_specs(config: LlamaConfig) -> dict:
-    """Megatron TP + fsdp layout, layer axis unsharded (it is scanned)."""
+    """Megatron TP + fsdp layout, layer axis unsharded (it is scanned).
+    MoE expert weights add the ``ep`` axis on their expert dimension
+    (each ep shard owns n_experts/ep experts; tokens reach them through
+    the dispatch einsum, whose collective XLA derives from these
+    shardings); the router is small and replicated over ep."""
+    if config.n_experts:
+        ffn = {
+            "w_router": P(None, "fsdp", None),
+            "w_gate": P(None, "ep", "fsdp", "tp"),
+            "w_up": P(None, "ep", "fsdp", "tp"),
+            "w_down": P(None, "ep", "tp", "fsdp"),
+        }
+    else:
+        ffn = {
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        }
     return {
         "embed": P("fsdp", None),
         "layers": {
@@ -140,9 +205,7 @@ def partition_specs(config: LlamaConfig) -> dict:
             "wk": P(None, "fsdp", "tp"),
             "wv": P(None, "fsdp", "tp"),
             "wo": P(None, "tp", "fsdp"),
-            "w_gate": P(None, "fsdp", "tp"),
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),
+            **ffn,
             "attn_norm": P(None, None),
             "mlp_norm": P(None, None),
         },
@@ -218,10 +281,85 @@ def matmul(x, w):
     return x @ w
 
 
+def _expert_matmul(t, w, pattern):
+    """Batched-over-experts einsum for raw or weight-only-int8 expert
+    leaves; the [E, 1, F] per-channel scale applies after the dot
+    (broadcasting over the capacity axis)."""
+    if is_quantized(w):
+        return jnp.einsum(pattern, t, w["int8"].astype(t.dtype)) \
+            * w["scale"].astype(t.dtype)
+    return jnp.einsum(pattern, t, w)
+
+
+def _moe_ffn(config: LlamaConfig, x, layer):
+    """Top-k routed mixture-of-experts SwiGLU FFN (GShard-style einsum
+    dispatch -- the SPMD-native formulation: the dispatch/combine
+    einsums carry the ``ep`` sharding from the expert weights
+    (partition_specs), so XLA derives the expert collectives from the
+    layout instead of hand-written all-to-alls.  No reference
+    counterpart: /root/reference has no parallelism at all (SURVEY
+    §2.5); EP is this build's own first-class axis.)
+
+    x: [B, S, D] normed activations.  Returns (ffn_out [B, S, D],
+    aux load-balance scalar).  Static shapes throughout: each expert
+    processes a fixed ``moe_capacity`` token buffer; tokens routed past
+    a full expert are dropped from that expert (their residual stream
+    is unaffected -- standard capacity semantics).
+    """
+    c = config
+    b, s, d = x.shape
+    n = b * s
+    e, k = c.n_experts, c.n_experts_per_token
+    cap = c.moe_capacity(n)
+    xf = x.reshape(n, d)
+
+    router_logits = (xf.astype(jnp.float32)
+                     @ layer["w_router"].astype(jnp.float32))   # [n,E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gates, choices = jax.lax.top_k(probs, k)                    # [n,k]
+    gates = gates / (gates.sum(-1, keepdims=True) + 1e-9)
+    onehot = jax.nn.one_hot(choices, e, dtype=jnp.float32)      # [n,k,E]
+    flat = onehot.reshape(n * k, e)
+    # Each (token, choice)'s slot in its expert's buffer: how many
+    # earlier rows picked the same expert (token-major order, so a
+    # token's k distinct choices never collide).
+    positions = ((jnp.cumsum(flat, axis=0) - flat) * flat).sum(-1)
+    keep = positions < cap                                      # [n*k]
+    pos_onehot = jax.nn.one_hot(positions.astype(jnp.int32), cap,
+                                dtype=jnp.float32) * keep[:, None]
+    # Dispatch/combine mask with the k choices PRE-SUMMED ([n, E, C];
+    # the [n, k, E, C] tensor is never materialized -- the einsum
+    # contracts k, and the sum is lossless because a token's k choices
+    # hit distinct experts, so each (token, expert, slot) cell has at
+    # most one contributor).  This [n, E, C] mask, in the compute
+    # dtype, is the MoE memory ceiling (~cf*k*n^2/e * e elements per
+    # layer); a sort/scatter router would remove the n^2 term if
+    # profiles ever demand longer training batches.
+    mask = jnp.einsum("nke,nkc->nec", onehot,
+                      pos_onehot.reshape(n, k, cap)).astype(x.dtype)
+    dispatch = jnp.einsum("nec,nd->ecd", mask, xf)
+    gate_h = jax.nn.silu(_expert_matmul(dispatch, layer["w_gate"],
+                                        "ecd,edf->ecf"))
+    up_h = _expert_matmul(dispatch, layer["w_up"], "ecd,edf->ecf")
+    out_e = _expert_matmul(gate_h * up_h, layer["w_down"],
+                           "ecf,efd->ecd")                      # [E,C,D]
+    gates_e = jnp.einsum("nke,nk->ne", onehot, gates)           # [n,E]
+    combine = mask * gates_e.astype(x.dtype)[:, :, None]        # [n,E,C]
+    out = jnp.einsum("nec,ecd->nd", combine, out_e)
+
+    # GShard load-balance aux: E * sum_e(fraction routed * mean prob),
+    # with fraction normalized over the n*k choices -- exactly 1.0 at
+    # perfect balance for any k, grows as routing collapses.
+    fraction = flat.reshape(n, k, e).sum(1).mean(0) / k
+    aux = e * jnp.sum(fraction * probs.mean(0))
+    return out.reshape(b, s, d), aux
+
+
 def _block(config: LlamaConfig, hidden, layer, kv_write):
     """One transformer block.  ``kv_write(q, k, v) -> attn_out``
     abstracts prefill-vs-decode cache handling (RoPE + cache write +
-    attention) and records the written cache on ``kv_write.updated``."""
+    attention) and records the written cache on ``kv_write.updated``.
+    Returns (hidden, moe aux-loss scalar -- 0 for dense FFN)."""
     c = config
     b, s, _ = hidden.shape
     hd = c.head_dim
@@ -235,10 +373,13 @@ def _block(config: LlamaConfig, hidden, layer, kv_write):
                              layer["wo"])
 
     x = rms_norm(hidden, layer["mlp_norm"], c.norm_eps)
+    if c.n_experts:
+        ffn_out, aux = _moe_ffn(c, x, layer)
+        return hidden + ffn_out, aux
     gate = jax.nn.silu(matmul(x, layer["w_gate"]))
     hidden = hidden + matmul(gate * matmul(x, layer["w_up"]),
                              layer["w_down"])
-    return hidden
+    return hidden, jnp.float32(0.0)
 
 
 def _forward_layers(params: dict, config: LlamaConfig, hidden,
@@ -257,35 +398,31 @@ def _forward_layers(params: dict, config: LlamaConfig, hidden,
     Activation sharding follows from the param/cache input shardings via
     SPMD propagation; serving/training wrappers pin in_shardings
     explicitly (see models/train.py, tpu elements).
+
+    Returns (logits, cache, aux) where aux is the summed MoE
+    load-balance loss over layers (0 for dense configs).
     """
-    def layer_step(hidden, xs):
+    def layer_step(carry, xs):
+        hidden, aux = carry
         layer, k_layer, v_layer = xs
         kv_write = kv_write_factory(k_layer, v_layer)
-        hidden2 = _block(config, hidden, layer, kv_write)
-        return hidden2, kv_write.updated
+        hidden2, aux2 = _block(config, hidden, layer, kv_write)
+        return (hidden2, aux + aux2), kv_write.updated
 
-    hidden, updates = jax.lax.scan(
-        layer_step, hidden,
+    (hidden, aux), updates = jax.lax.scan(
+        layer_step, (hidden, jnp.float32(0.0)),
         (params["layers"], cache["k"], cache["v"]))
     hidden = rms_norm(hidden, params["final_norm"], config.norm_eps)
     logits = matmul(hidden, params["unembed"])
     if cache_from_updates is not None:
-        return logits, cache_from_updates(updates)
+        return logits, cache_from_updates(updates), aux
     k_new, v_new = updates
-    return logits, {"k": k_new, "v": v_new}
+    return logits, {"k": k_new, "v": v_new}, aux
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
-            cache: dict, start_positions: jax.Array) \
-        -> tuple[jax.Array, dict]:
-    """Process a prompt chunk, writing the cache.
-
-    tokens: [B, S] (right-padded chunks allowed -- positions beyond a
-    sequence's true content are simply overwritten by later chunks);
-    start_positions: [B] cache offset each row's chunk begins at.
-    Returns (logits [B, S, vocab], cache).
-    """
+def _prefill_core(params: dict, config: LlamaConfig, tokens: jax.Array,
+                  cache: dict, start_positions: jax.Array):
+    """Shared prefill body -> (logits, cache, moe aux)."""
     c = config
     b, s = tokens.shape
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
@@ -310,6 +447,33 @@ def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
 
     return _forward_layers(params, c, params["embed"][tokens], cache,
                            factory)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
+            cache: dict, start_positions: jax.Array) \
+        -> tuple[jax.Array, dict]:
+    """Process a prompt chunk, writing the cache.
+
+    tokens: [B, S] (right-padded chunks allowed -- positions beyond a
+    sequence's true content are simply overwritten by later chunks);
+    start_positions: [B] cache offset each row's chunk begins at.
+    Returns (logits [B, S, vocab], cache).
+    """
+    logits, cache, _ = _prefill_core(params, config, tokens, cache,
+                                     start_positions)
+    return logits, cache
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_with_aux(params: dict, config: LlamaConfig,
+                     tokens: jax.Array, cache: dict,
+                     start_positions: jax.Array) \
+        -> tuple[jax.Array, dict, jax.Array]:
+    """:func:`prefill` that also returns the summed MoE load-balance
+    aux loss over layers (the MoE training path; 0 for dense)."""
+    return _prefill_core(params, config, tokens, cache,
+                         start_positions)
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -363,8 +527,9 @@ def prefill_into_slot(params: dict, config: LlamaConfig,
             return attention_prefill(q, k_row, v_row, positions)
         return kv_write
 
-    return _forward_layers(params, c, params["embed"][tokens], cache,
-                           factory)
+    logits, cache, _ = _forward_layers(
+        params, c, params["embed"][tokens], cache, factory)
+    return logits, cache
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -420,8 +585,9 @@ def prefill_into_slots(params: dict, config: LlamaConfig,
             return attention_prefill(q, k_rows, v_rows, positions)
         return kv_write
 
-    return _forward_layers(params, c, params["embed"][tokens], cache,
-                           factory)
+    logits, cache, _ = _forward_layers(
+        params, c, params["embed"][tokens], cache, factory)
+    return logits, cache
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -471,7 +637,7 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
         return {"k": scatter(cache["k"], k_tokens),
                 "v": scatter(cache["v"], v_tokens)}
 
-    logits, new_cache = _forward_layers(
+    logits, new_cache, _ = _forward_layers(
         params, c, params["embed"][tokens][:, None, :], cache, factory,
         cache_from_updates=scatter_tokens)
     return logits[:, 0, :], new_cache

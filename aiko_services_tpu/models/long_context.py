@@ -61,7 +61,8 @@ def make_long_context_forward(config: llama.LlamaConfig, plan: MeshPlan,
                            batch_axis=batch_axis, head_axis=head_axis)
 
         def layer_step(hidden, layer):
-            return llama._block(c, hidden, layer, cp_attention), None
+            hidden2, _aux = llama._block(c, hidden, layer, cp_attention)
+            return hidden2, None
 
         hidden, _ = jax.lax.scan(layer_step, hidden, params["layers"])
         hidden = rms_norm(hidden, params["final_norm"], c.norm_eps)

@@ -22,10 +22,14 @@ from ..parallel.mesh import MeshPlan, P
 __all__ = ["make_train_step", "init_train_state", "language_model_loss"]
 
 
-def language_model_loss(params, config, tokens):
-    """Next-token cross-entropy over [B, S] token batches (shift-by-one)."""
+def language_model_loss(params, config, tokens,
+                        moe_aux_weight: float = 0.01):
+    """Next-token cross-entropy over [B, S] token batches
+    (shift-by-one).  MoE configs add the GShard load-balance aux loss
+    so the router learns to spread tokens across the ep-sharded
+    experts."""
     cache = llama.init_cache(config, tokens.shape[0], tokens.shape[1])
-    logits, _ = llama.prefill.__wrapped__(
+    logits, _, aux = llama.prefill_with_aux.__wrapped__(
         params, config, tokens, cache,
         jnp.zeros(tokens.shape[0], dtype=jnp.int32))
     targets = tokens[:, 1:]
@@ -33,7 +37,10 @@ def language_model_loss(params, config, tokens):
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(log_probs, targets[..., None],
                                  axis=-1)[..., 0]
-    return -picked.mean()
+    loss = -picked.mean()
+    if config.n_experts:
+        loss = loss + moe_aux_weight * aux
+    return loss
 
 
 def init_train_state(key, config: llama.LlamaConfig, plan: MeshPlan,
