@@ -52,7 +52,11 @@ class ASR(PipelineElement):
 
     Parameters: ``checkpoint`` (orbax dir of fitted AsrConfig weights),
     ``model_size`` (``tiny``/``base``), ``sample_rate`` (model rate,
-    default 16000).  Input audio at another rate should pass through
+    default 16000), ``streaming`` (true: incremental live mode -- each
+    frame's audio feeds a per-stream :class:`StreamingAsr`, the frame
+    emits whatever text completed chunks produced, and ``stop_stream``
+    flushes the tail; the ``mic://`` -> ASR live path).  Input audio at
+    another rate should pass through
     :class:`~aiko_services_tpu.elements.audio.AudioResampler` first
     (same contract as the reference's resampler -> whisper chain).
     """
@@ -65,6 +69,7 @@ class ASR(PipelineElement):
         self._params = None
         self._config = None
         self._bucketer = ShapeBucketer(minimum=1)
+        self._streamers: dict = {}
 
     def _ensure_model(self):
         if self._params is not None:
@@ -81,6 +86,11 @@ class ASR(PipelineElement):
                                   self._config),
             checkpoint)
 
+    def _streaming(self) -> bool:
+        streaming, _ = self.get_parameter("streaming", False)
+        return str(streaming).strip().lower() in ("true", "1", "yes",
+                                                  "on")
+
     def process_frame(self, stream, audio=None, sample_rate=16000,
                       **inputs):
         try:
@@ -95,6 +105,12 @@ class ASR(PipelineElement):
         samples = np.asarray(audio, dtype=np.float32)
         if samples.ndim == 2:                      # [N, C] -> mono
             samples = samples.mean(axis=-1)
+        if self._streaming():
+            streamer = self._streamers.get(stream.stream_id)
+            if streamer is None:
+                streamer = asr_model.StreamingAsr(self._params, config)
+                self._streamers[stream.stream_id] = streamer
+            return StreamEvent.OKAY, {"text": streamer.push(samples)}
         chunk = int(config.sample_rate * config.chunk_seconds)
         true_rows = max(1, -(-len(samples) // chunk))
         rows = _chunk_rows(samples, chunk, self._bucketer)
@@ -105,6 +121,15 @@ class ASR(PipelineElement):
         text = "".join(asr_model.decode_text(config, row)
                        for row in np.asarray(tokens)[:true_rows])
         return StreamEvent.OKAY, {"text": text}
+
+    def stop_stream(self, stream, stream_id):
+        streamer = self._streamers.pop(stream_id, None)
+        if streamer is not None:
+            tail = streamer.flush()
+            if tail:
+                # The stream is closing; surface the tail on the share
+                # so callers (and tests) can retrieve it.
+                self.pipeline.share[f"asr_tail_{stream_id}"] = tail
 
 
 class TTS(PipelineElement):

@@ -16,6 +16,14 @@ Whisper-class shape, house architecture (shared with models/llama.py):
 - **greedy transcribe** runs the whole decode as one ``lax.scan`` with
   a static token budget (no data-dependent Python control flow; EOS
   handled by masking) -- one trace, one compile per audio bucket.
+  The decode is KV-CACHED: cross-attention K/V are projected once per
+  utterance, self-attention K/V append to a cache (the same split-
+  softmax read-only-cache pattern as models/llama.py decode), so a
+  transcription costs O(S) decoder work, not the O(S^2) of re-running
+  the teacher-forced decoder per emitted token;
+- **StreamingAsr** transcribes live audio incrementally: push samples,
+  full chunks each cost exactly one compiled dispatch (bounded
+  per-chunk latency for the mic -> text path).
 
 Audio is right-padded to a fixed chunk (``chunk_seconds``) so every
 utterance compiles to the same shapes (the ShapeBucketer idea applied
@@ -31,10 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.layers import rms_norm, swiglu
+from ..ops.layers import attention_decode_append, rms_norm, swiglu
 
 __all__ = ["AsrConfig", "init_params", "log_mel", "encode",
-           "transcribe", "asr_loss", "partition_specs"]
+           "transcribe", "asr_loss", "partition_specs",
+           "StreamingAsr"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -318,36 +327,80 @@ def transcribe(params: dict, config: AsrConfig,
                samples: jax.Array) -> jax.Array:
     """Greedy decode: waveform [B, T_chunk] -> token ids [B, max_text].
 
-    The decode loop is a single ``lax.scan`` with a static budget; after
-    EOS a row keeps emitting EOS (masked), so shapes stay static and the
-    whole transcription compiles once per audio bucket.  Re-running the
-    teacher-forced decoder per step is O(S^2) in decoder depth -- fine
-    for ``max_text`` ~128; the serving path can graduate to a KV cache
-    exactly as models/llama.py does if profiles demand it.
+    KV-cached O(S) decode (the models/llama.py pattern applied to the
+    encoder-decoder): cross-attention keys/values are projected ONCE
+    per utterance, each step's self-attention reads the read-only cache
+    via the split-softmax append (ops/layers.py
+    attention_decode_append, with K = H: plain multi-head), and the
+    step's k/v pair is written back with one dynamic_update_slice.  The
+    loop is a single ``lax.scan`` with a static budget; after EOS a row
+    keeps emitting EOS (masked), so shapes stay static and the whole
+    transcription compiles once per audio bucket.
     """
     c = config
+    dtype = _dtype(c)
     encoded = encode(params, c, log_mel(c, samples))
     batch = samples.shape[0]
-    tokens = jnp.full((batch, c.max_text + 1), c.bos_token,
-                      dtype=jnp.int32)
+    hd = c.head_dim
+
+    # Cross-attention K/V once per utterance: [L, B, T_enc, D'].
+    def cross_step(_, layer):
+        return None, (encoded @ layer["xk"], encoded @ layer["xv"])
+    _, (xk_all, xv_all) = jax.lax.scan(cross_step, None,
+                                       params["decoder"])
+
+    cache_shape = (c.n_decoder_layers, batch, c.max_text, c.n_heads, hd)
+    cache_k = jnp.zeros(cache_shape, dtype=dtype)
+    cache_v = jnp.zeros(cache_shape, dtype=dtype)
+    pos_table = jnp.asarray(_sinusoid(c.max_text, c.dim))
+    current = jnp.full((batch,), c.bos_token, dtype=jnp.int32)
     finished = jnp.zeros((batch,), dtype=bool)
 
     def step(carry, i):
-        tokens, finished = carry
-        logits = _decode_states(params, c, tokens[:, :-1], encoded)
-        # Only position i-1's logits matter this step.
-        current = jax.lax.dynamic_slice_in_dim(
-            logits, i, 1, axis=1)[:, 0, :]
-        next_token = jnp.argmax(current, axis=-1).astype(jnp.int32)
+        current, finished, cache_k, cache_v = carry
+        hidden = params["embed"][current][:, None, :] \
+            + pos_table[i][None, None, :].astype(dtype)
+        lengths = jnp.full((batch,), i, dtype=jnp.int32)
+
+        def layer_step(hidden, xs):
+            layer, k_cache, v_cache, xk, xv = xs
+            h = rms_norm(hidden, layer["attn_norm"], c.norm_eps)
+            q = (h @ layer["wq"]).reshape(batch, 1, c.n_heads, hd)
+            k = (h @ layer["wk"]).reshape(batch, 1, c.n_heads, hd)
+            v = (h @ layer["wv"]).reshape(batch, 1, c.n_heads, hd)
+            attn = attention_decode_append(q, k_cache, v_cache, k, v,
+                                           lengths)
+            hidden = hidden + attn.reshape(batch, 1, -1) @ layer["wo"]
+            h = rms_norm(hidden, layer["cross_norm"], c.norm_eps)
+            cross = _attention(h @ layer["xq"], xk, xv, c.n_heads,
+                               causal=False)
+            hidden = hidden + cross @ layer["xo"]
+            h = rms_norm(hidden, layer["mlp_norm"], c.norm_eps)
+            hidden = hidden + swiglu(h, layer["w_gate"], layer["w_up"],
+                                     layer["w_down"])
+            return hidden, (k, v)
+
+        hidden, (k_new, v_new) = jax.lax.scan(
+            layer_step, hidden,
+            (params["decoder"], cache_k, cache_v, xk_all, xv_all))
+        hidden = rms_norm(hidden, params["decoder_norm"], c.norm_eps)
+        logits = (hidden @ params["embed"].T)[:, 0]
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         next_token = jnp.where(finished, c.eos_token, next_token)
         finished = finished | (next_token == c.eos_token)
-        tokens = jax.lax.dynamic_update_slice_in_dim(
-            tokens, next_token[:, None], i + 1, axis=1)
-        return (tokens, finished), None
+        # k_new/v_new: [L, B, 1, H, hd] -- one DUS writes every layer's
+        # token at position i (read-only inside the layer scan, exactly
+        # the llama decode cache discipline).
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k_new,
+                                               (0, 0, i, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v_new,
+                                               (0, 0, i, 0, 0))
+        return (next_token, finished, cache_k, cache_v), next_token
 
-    (tokens, _), _ = jax.lax.scan(step, (tokens, finished),
-                                  jnp.arange(c.max_text))
-    return tokens[:, 1:]
+    (_, _, _, _), emitted = jax.lax.scan(
+        step, (current, finished, cache_k, cache_v),
+        jnp.arange(c.max_text))
+    return emitted.T                                # [B, max_text]
 
 
 def decode_text(config: AsrConfig, token_row) -> str:
@@ -363,6 +416,60 @@ def decode_text(config: AsrConfig, token_row) -> str:
 
 def encode_text(config: AsrConfig, text: str) -> list[int]:
     return list(text.encode("utf-8"))[:config.max_text - 1]
+
+
+class StreamingAsr:
+    """Incremental transcription for live audio (the ``mic://`` -> text
+    path; reference equivalent: examples/speech/speech_elements.py
+    PE_WhisperX's LRU sliding window at :53-84, which batch-reprocesses
+    the window -- here each full chunk costs exactly ONE compiled
+    dispatch, so per-chunk latency is bounded by one transcribe call).
+
+    Usage::
+
+        streamer = StreamingAsr(params, config)
+        text += streamer.push(mic_samples)      # '' until a chunk fills
+        text += streamer.flush()                # transcribe the tail
+
+    Chunks are independent utterance windows (no cross-chunk decoder
+    state): a word split across a chunk boundary may be mis-recognized,
+    the standard chunked-streaming trade-off; choose chunk_seconds to
+    taste.  ``push`` accepts arbitrary-size sample batches and may emit
+    text for several chunks at once after a long gap.
+    """
+
+    def __init__(self, params, config: AsrConfig):
+        self.params = params
+        self.config = config
+        self.chunk = int(config.sample_rate * config.chunk_seconds)
+        self._pending = np.zeros((0,), dtype=np.float32)
+        self.chunks_transcribed = 0
+
+    def _transcribe_one(self, chunk_samples: np.ndarray) -> str:
+        tokens = transcribe(self.params, self.config,
+                            jnp.asarray(chunk_samples[None]))
+        self.chunks_transcribed += 1
+        return decode_text(self.config, np.asarray(tokens)[0])
+
+    def push(self, samples) -> str:
+        """Append samples; transcribe every full chunk now buffered.
+        Returns the newly recognized text ('' while the chunk fills)."""
+        samples = np.asarray(samples, dtype=np.float32).reshape(-1)
+        self._pending = np.concatenate([self._pending, samples])
+        emitted = []
+        while len(self._pending) >= self.chunk:
+            chunk, self._pending = (self._pending[:self.chunk],
+                                    self._pending[self.chunk:])
+            emitted.append(self._transcribe_one(chunk))
+        return "".join(emitted)
+
+    def flush(self) -> str:
+        """Transcribe whatever partial chunk remains (zero-padded)."""
+        if not len(self._pending):
+            return ""
+        tail, self._pending = self._pending, \
+            np.zeros((0,), dtype=np.float32)
+        return self._transcribe_one(pad_audio(self.config, tail))
 
 
 def asr_loss(params: dict, config: AsrConfig, samples: jax.Array,
