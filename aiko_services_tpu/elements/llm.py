@@ -43,7 +43,7 @@ from ..pipeline import PipelineElement, StreamEvent
 from ..services import Actor
 from ..utils import generate, get_logger, parse_number
 
-__all__ = ["LLMService", "LLM", "PROTOCOL_LLM"]
+__all__ = ["LLMService", "LLM", "DetectionCaption", "PROTOCOL_LLM"]
 
 _logger = get_logger("aiko.llm")
 
@@ -156,6 +156,31 @@ class LLMService(Actor):
         return self.tokenizer.decode(collected)
 
 
+class DetectionCaption(PipelineElement):
+    """``detections`` (Detector output dicts) -> ``text`` prompt for a
+    downstream LLM stage -- the detect->describe bridge of the
+    video->detect->caption pipeline (BASELINE config 4; reference
+    equivalent: examples/llm/elements.py:204 Detection, which formats
+    detections into the Ollama prompt).
+
+    Parameter ``template`` wraps the summary (``{detections}``
+    placeholder)."""
+
+    def process_frame(self, stream, detections=None, **inputs):
+        detections = detections or []
+        counts: dict[str, int] = {}
+        for detection in detections:
+            name = str(detection.get("class", "object"))
+            counts[name] = counts.get(name, 0) + 1
+        summary = ", ".join(
+            f"{count} {name}" if count > 1 else name
+            for name, count in sorted(counts.items())) or "nothing"
+        template, _ = self.get_parameter(
+            "template", "Describe a scene containing: {detections}.")
+        return StreamEvent.OKAY, {
+            "text": str(template).format(detections=summary)}
+
+
 class LLM(PipelineElement):
     """``text`` -> generated ``text``.
 
@@ -194,10 +219,18 @@ class LLM(PipelineElement):
         # "flash" routes chunked admission through the Pallas kernel --
         # the long-context setting (2.5x dense at 8k on v5e).
         attention, _ = self.get_parameter("attention", "dense")
-        config = dataclasses.replace(
-            llama.LlamaConfig.tiny(vocab_size=int(vocab),
-                                   max_seq=int(max_seq)),
-            attention=str(attention))
+        model, _ = self.get_parameter("model", "tiny")
+        bases = {"tiny": llama.LlamaConfig.tiny,
+                 "tiny-moe": llama.LlamaConfig.tiny_moe,
+                 "llama3-1b": llama.LlamaConfig.llama3_1b,
+                 "llama3-8b": llama.LlamaConfig.llama3_8b}
+        if str(model) not in bases:
+            raise ValueError(f"model={model!r}: one of {sorted(bases)}")
+        base = bases[str(model)]()
+        if str(model).startswith("tiny"):
+            base = dataclasses.replace(base, vocab_size=int(vocab))
+        config = dataclasses.replace(base, max_seq=int(max_seq),
+                                     attention=str(attention))
         params = _restore(
             llama.init_params(jax.random.PRNGKey(int(seed)), config),
             checkpoint)

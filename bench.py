@@ -116,12 +116,13 @@ def tree_bytes(tree) -> int:
 # ---------------------------------------------------------------------------
 # 1. Control plane: 3-stage chained pipelines (the multitude topology).
 
-def element(name, cls, inputs, outputs, parameters=None):
+def element(name, cls, inputs, outputs, parameters=None,
+            module="aiko_services_tpu.elements.common"):
     return {"name": name,
             "input": [{"name": n} for n in inputs],
             "output": [{"name": n} for n in outputs],
             "deploy": {"local": {
-                "module": "aiko_services_tpu.elements.common",
+                "module": module,
                 "class_name": cls}},
             "parameters": parameters or {}}
 
@@ -504,6 +505,141 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 4. End-to-end pipeline (BASELINE config 4, single-chip): synthetic
+#    video frames -> Detector -> DetectionCaption -> LLM caption through
+#    the REAL engine, measuring whole-pipeline frames/s and p50 per-stage
+#    latency out of frame.metrics -- the framework overhead AROUND the
+#    models, which the device-loop sections above deliberately exclude.
+
+E2E_FRAMES = 24
+E2E_WARMUP = 2
+
+
+def bench_pipeline_e2e() -> dict:
+    import numpy as np
+    from aiko_services_tpu.pipeline import Pipeline
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.transport import reset_broker
+
+    reset_broker()
+    reset_process()
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+
+    definition = {
+        "version": 0, "name": "bench_e2e", "runtime": "jax",
+        "graph": ["(DET (CAP (LLM)))"],
+        "parameters": {},
+        "elements": [
+            element("DET", "Detector", ["image"],
+                    ["image", "overlay", "detections"],
+                    module="aiko_services_tpu.elements.detect"),
+            element("CAP", "DetectionCaption", ["detections"], ["text"],
+                    module="aiko_services_tpu.elements.llm"),
+            element("LLM", "LLM", ["text"], ["text"],
+                    # The serving-shaped decode config: llama3-1b-class
+                    # weights, int8, fused blocks (3 in flight).
+                    {"model": "llama3-1b", "max_seq": 512,
+                     "quantize": "int8", "decode_block": 16,
+                     "inflight": 3, "max_new_tokens": 32},
+                    module="aiko_services_tpu.elements.llm"),
+        ]}
+    pipeline = Pipeline(definition, runtime=runtime)
+
+    rng = np.random.default_rng(0)
+    responses: "queue.Queue" = queue.Queue()
+    collected: list = []
+
+    def pump(count):
+        for _ in range(count):
+            image = rng.integers(0, 255, (640, 640, 3),
+                                 dtype=np.uint8)
+            pipeline.process_frame_local({"image": image},
+                                         stream_id="bench_e2e",
+                                         queue_response=responses)
+
+    def drain(target):
+        while not responses.empty():
+            *_, metrics, okay, _diag = responses.get()
+            collected.append((metrics, okay))
+        return len(collected) >= target
+
+    pump(E2E_WARMUP)                         # compiles detector + LLM
+    runtime.run(until=lambda: drain(E2E_WARMUP), timeout=600.0)
+    if len(collected) < E2E_WARMUP:
+        return {"pipeline_e2e_error": "warmup stalled"}
+    collected.clear()
+
+    start = time.perf_counter()
+    pump(E2E_FRAMES)
+    runtime.run(until=lambda: drain(E2E_FRAMES), timeout=600.0)
+    elapsed = time.perf_counter() - start
+    runtime.terminate()
+    okay_count = sum(1 for _, okay in collected if okay)
+    if not collected or okay_count < len(collected):
+        return {"pipeline_e2e_error":
+                f"{okay_count}/{len(collected)} frames ok"}
+
+    def p50(key):
+        values = sorted(metrics.get(key, 0.0)
+                        for metrics, _ in collected)
+        return values[len(values) // 2]
+
+    return {
+        "pipeline_e2e_fps": round(len(collected) / elapsed, 2),
+        "pipeline_e2e_frames": len(collected),
+        "pipeline_e2e_p50_ms": round(p50("time_pipeline") * 1000, 1),
+        "pipeline_e2e_p50_detect_ms": round(p50("DET_time") * 1000, 1),
+        "pipeline_e2e_p50_caption_ms": round(p50("CAP_time") * 1000, 2),
+        "pipeline_e2e_p50_llm_ms": round(p50("LLM_time") * 1000, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# 5. ASR real-time factor (BASELINE config 5): seconds of audio
+#    transcribed per wall-clock second, batch of chunks, one dispatch
+#    (mel frontend + encoder + KV-cached 128-token greedy decode all
+#    on-device; the decode scan always runs the full static budget, so
+#    random weights time the same program fitted ones would).
+
+def bench_asr(rtt: float) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from aiko_services_tpu.models import asr as asr_model
+
+    from jax import lax
+
+    config = asr_model.AsrConfig.base()
+    params = asr_model.init_params(jax.random.PRNGKey(0), config)
+    batch = 8
+    iters = 8          # one batch transcription is faster than the
+    chunk = int(config.sample_rate * config.chunk_seconds)   # tunnel RTT
+    audio = jax.random.normal(jax.random.PRNGKey(1),
+                              (batch, chunk)) * 0.1
+
+    @jax.jit
+    def loop(params, audio):
+        def body(i, acc):
+            perturbed = audio + i.astype(audio.dtype) * 1e-6
+            tokens = asr_model.transcribe.__wrapped__(params, config,
+                                                      perturbed)
+            return acc + tokens.sum()
+        return lax.fori_loop(0, iters, body, jnp.int32(0))
+
+    int(loop(params, audio))                       # compile + warm
+    elapsed = time_device_loop(lambda: int(loop(params, audio)), rtt)
+    audio_seconds = batch * iters * config.chunk_seconds
+    return {
+        "asr_model": "whisper-class-base",
+        "asr_batch": batch,
+        "asr_chunk_seconds": config.chunk_seconds,
+        "asr_rtf": round(audio_seconds / elapsed, 1),
+        "asr_batch_latency_ms": round(elapsed / iters * 1000, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
 
 def main() -> int:
     logging.disable(logging.WARNING)
@@ -524,7 +660,9 @@ def main() -> int:
     for name, section in (
             ("bench_control", bench_control),
             ("bench_detect", lambda: bench_detect(peak, rtt)),
-            ("bench_llm", lambda: bench_llm(peak, rtt))):
+            ("bench_llm", lambda: bench_llm(peak, rtt)),
+            ("bench_pipeline_e2e", bench_pipeline_e2e),
+            ("bench_asr", lambda: bench_asr(rtt))):
         try:
             record.update(section())
         except Exception as error:          # keep the other sections
