@@ -177,8 +177,10 @@ class DetectionCaption(PipelineElement):
             for name, count in sorted(counts.items())) or "nothing"
         template, _ = self.get_parameter(
             "template", "Describe a scene containing: {detections}.")
+        # Plain replace, not str.format: templates may legitimately
+        # contain literal braces (JSON-shaped prompts).
         return StreamEvent.OKAY, {
-            "text": str(template).format(detections=summary)}
+            "text": str(template).replace("{detections}", summary)}
 
 
 class LLM(PipelineElement):
@@ -212,8 +214,7 @@ class LLM(PipelineElement):
         tokenizer_path, found = self.get_parameter("tokenizer", None)
         self._tokenizer = load_tokenizer(tokenizer_path) \
             if found and tokenizer_path else ByteTokenizer()
-        vocab, _ = self.get_parameter("vocab_size",
-                                      self._tokenizer.vocab_size)
+        vocab, vocab_found = self.get_parameter("vocab_size", None)
         max_seq, _ = self.get_parameter("max_seq", 256)
         seed, _ = self.get_parameter("seed", 0)
         # "flash" routes chunked admission through the Pallas kernel --
@@ -227,8 +228,14 @@ class LLM(PipelineElement):
         if str(model) not in bases:
             raise ValueError(f"model={model!r}: one of {sorted(bases)}")
         base = bases[str(model)]()
-        if str(model).startswith("tiny"):
+        # An explicit vocab_size always wins (it must match the
+        # tokenizer/checkpoint); otherwise tiny configs follow the
+        # tokenizer and the llama configs keep their own vocab.
+        if vocab_found and vocab is not None:
             base = dataclasses.replace(base, vocab_size=int(vocab))
+        elif str(model).startswith("tiny"):
+            base = dataclasses.replace(
+                base, vocab_size=self._tokenizer.vocab_size)
         config = dataclasses.replace(base, max_seq=int(max_seq),
                                      attention=str(attention))
         params = _restore(

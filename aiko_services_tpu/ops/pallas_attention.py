@@ -16,6 +16,7 @@ the identical code path on the CPU mesh (SURVEY.md section 4 strategy).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +35,8 @@ _STAT_LANES = 128      # softmax stats replicated across the lane dim
 
 def _flash_kernel(offset_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *,
-                  block_q, block_k, scale, causal, kv_len, rows_per_head):
+                  block_q, block_k, causal, kv_len, rows_per_head,
+                  scale):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     qi = pl.program_id(1)
@@ -54,16 +56,59 @@ def _flash_kernel(offset_ref, q_ref, k_ref, v_ref, o_ref,
 
     # Causal: skip KV blocks strictly above this Q block's last row.
     live = (k_start <= q_start + block_q - 1) if causal else True
+    # Interior blocks need NO masking: every key position is both
+    # in-range and at-or-before every query position.  The mask path
+    # (2 iotas + compares + 2 wheres on [bq, bk] f32) costs about as
+    # much VPU time as the exp itself, and on a long prompt nearly all
+    # blocks are interior -- splitting the paths roughly halves the
+    # non-matmul work (the splash-attention trick).
+    in_range = k_start + block_k <= kv_len
+    interior = jnp.logical_and(
+        in_range,
+        (k_start + block_k - 1 <= q_start) if causal else True)
 
-    @pl.when(live)
-    def _compute():
-        q = q_ref[0]                                    # [bq, d]
-        k = k_ref[0]                                    # [bk, d]
-        v = v_ref[0]
+    def _online_update(s, p_mask=None):
+        m_prev = m_scr[:, :1]                           # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        # exp in bf16: the PV matmul consumes bf16 weights anyway and
+        # the l-sum accumulates in f32, so the only cost is ~0.4%
+        # relative error on individual softmax weights -- the same
+        # order as the bf16 rounding of V itself -- while the [bq, bk]
+        # transcendental (the largest VPU item in the loop) runs at
+        # twice the f32 rate and the separate cast disappears.
+        p = jnp.exp((s - m_safe).astype(v_ref.dtype))
+        if p_mask is not None:
+            p = jnp.where(p_mask, p, jnp.zeros_like(p))
+        correction = jnp.exp(m_prev - m_safe)
+        l_scr[...] = jnp.broadcast_to(
+            l_prev * correction
+            + jnp.sum(p, axis=1, keepdims=True, dtype=jnp.float32),
+            l_scr.shape)
+        pv = jax.lax.dot_general(
+            p, v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, d]
+        acc_scr[...] = acc_scr[...] * correction + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    def _scores():
+        # scale is None when the caller folded it into q losslessly
+        # (d**-0.5 a power of two); otherwise applied to the f32
+        # scores here (trace-time branch, no kernel cost when None).
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        return s if scale is None else s * scale
 
+    @pl.when(jnp.logical_and(live, interior))
+    def _compute_interior():
+        _online_update(_scores())
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(interior)))
+    def _compute_boundary():
+        s = _scores()
         q_pos = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = k_start + jax.lax.broadcasted_iota(
@@ -71,24 +116,7 @@ def _flash_kernel(offset_ref, q_ref, k_ref, v_ref, o_ref,
         mask = k_pos < kv_len
         if causal:
             mask = jnp.logical_and(mask, k_pos <= q_pos)
-        s = jnp.where(mask, s, _NEG_INF)
-
-        m_prev = m_scr[:, :1]                           # [bq, 1]
-        l_prev = l_scr[:, :1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - m_safe)
-        p = jnp.where(mask, p, 0.0)
-        correction = jnp.exp(m_prev - m_safe)
-
-        l_new = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, d]
-        acc_scr[...] = acc_scr[...] * correction + pv
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        _online_update(jnp.where(mask, s, _NEG_INF), p_mask=mask)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -113,7 +141,7 @@ def _round_up(n, multiple):
 @functools.partial(jax.jit, static_argnames=(
     "causal", "block_q", "block_k", "interpret"))
 def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
-                    block_q: int = 256, block_k: int = 1024,
+                    block_q: int = 512, block_k: int = 1024,
                     interpret: bool | None = None):
     """Causal flash attention.
 
@@ -122,11 +150,17 @@ def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
     no repeat materialized).  ``q_offset`` is the absolute position of q
     row 0 (chunked prefill against a longer KV); it is a traced scalar,
     so sweeping offsets does not recompile.  Returns [B, S, H, d] in
-    q's dtype; softmax in float32.
+    q's dtype; scores and softmax statistics (max/sum/correction) in
+    float32, individual weights exponentiated in the value dtype (bf16
+    for bf16 inputs -- ~0.4% per-weight, the same order as V's own
+    rounding; see _online_update).
 
-    Default blocks (256 x 1024) are tuned on v5e at head_dim 64 / 8k
-    context: ~2.5x faster than 128 x 128 (the small-d dot leaves the
-    MXU underfed; a wide KV block amortizes the VPU softmax work).
+    Default blocks (512 x 1024) are tuned on v5e at head_dim 64 / 8k
+    context: ~34% of chip peak on the fully-live causal region (vs 16%
+    for the round-2 kernel).  The d=64 contraction halves the MXU feed,
+    so the ceiling is ~50%; the rest of the gap was VPU softmax work,
+    cut by the interior/boundary split (most blocks skip masking
+    entirely), the bf16 exp, and folding the scale into q.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -152,11 +186,20 @@ def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
                   1, block_k)
     rows_pad, t_pad = q_r.shape[1], k_r.shape[1]
 
+    # Fold the softmax scale into q when that is LOSSLESS in q's dtype
+    # (d**-0.5 an exact power of two, e.g. 1/8 at d = 64) -- saving a
+    # [bq, bk] VPU multiply per block; otherwise (d = 128: 2^-3.5) the
+    # kernel scales the f32 scores as before.
+    scale = d ** -0.5
+    if math.log2(scale).is_integer():
+        q_r = (q_r.astype(jnp.float32) * scale).astype(q_r.dtype)
+        scale = None
+
     grid = (b * h_kv, rows_pad // block_q, t_pad // block_k)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k,
-        scale=d ** -0.5, causal=causal, kv_len=t,
-        rows_per_head=rows_per_head)
+        causal=causal, kv_len=t, rows_per_head=rows_per_head,
+        scale=scale)
 
     def kv_block(bh, qi, ki, offset):
         # Clamp dead KV blocks (fully above the causal frontier) to the

@@ -235,36 +235,46 @@ def bench_detect(peak: float | None, rtt: float) -> dict:
     from jax import lax
     from aiko_services_tpu.models import detector
 
-    config = detector.DetectorConfig()          # 80 classes, YOLO-n scale
-    params = detector.init_params(jax.random.PRNGKey(0), config)
+    import dataclasses
+
     result = {}
-    for tag, batch, iters in (("detect", 1, 500),
-                              ("detect_batch8", 8, 200)):
-        images = jax.random.uniform(
-            jax.random.PRNGKey(1), (batch, 640, 640, 3),
-            dtype=jnp.bfloat16)
-        flops = compiled_flops(
-            detector.detect.lower(params, config, images))
+    # YOLO-n scale (width 32) and YOLO-s scale (width 64, depth 2):
+    # the wider config feeds the MXU better (channel dims 128-512 vs
+    # 64-256), which is where the conv MFU comes from.
+    for scale, config, runs in (
+            ("", detector.DetectorConfig(),
+             (("", 1, 500), ("_batch8", 8, 200))),
+            ("_s", dataclasses.replace(detector.DetectorConfig(),
+                                       width=64, depth=2),
+             (("_batch8", 8, 100),))):
+        params = detector.init_params(jax.random.PRNGKey(0), config)
+        for suffix, batch, iters in runs:
+            tag = f"detect{scale}{suffix}"
+            images = jax.random.uniform(
+                jax.random.PRNGKey(1), (batch, 640, 640, 3),
+                dtype=jnp.bfloat16)
+            flops = compiled_flops(
+                detector.detect.lower(params, config, images))
 
-        @partial(jax.jit, static_argnames=())
-        def loop(params, images, n=iters):
-            # Perturb the input per iteration (data dependency on the
-            # loop index) so XLA cannot hoist the loop-invariant body.
-            def body(i, acc):
-                shifted = images + (i.astype(images.dtype) * 1e-6)
-                out = detector.detect.__wrapped__(params, config,
-                                                  shifted)
-                return acc + out["scores"].sum().astype(jnp.float32)
-            return lax.fori_loop(0, n, body, jnp.float32(0.0))
+            @partial(jax.jit, static_argnames=())
+            def loop(params, images, n=iters, config=config):
+                # Perturb the input per iteration (data dependency on
+                # the loop index) so XLA cannot hoist the body.
+                def body(i, acc):
+                    shifted = images + (i.astype(images.dtype) * 1e-6)
+                    out = detector.detect.__wrapped__(params, config,
+                                                      shifted)
+                    return acc + out["scores"].sum().astype(jnp.float32)
+                return lax.fori_loop(0, n, body, jnp.float32(0.0))
 
-        float(loop(params, images))                    # compile + warm
-        elapsed = time_device_loop(
-            lambda: float(loop(params, images)), rtt)
-        fps = batch * iters / elapsed
-        result[f"{tag}_fps"] = round(fps, 1)
-        if flops and peak:
-            result[f"{tag}_mfu"] = round(flops * iters / elapsed / peak,
-                                         4)
+            float(loop(params, images))                # compile + warm
+            elapsed = time_device_loop(
+                lambda: float(loop(params, images)), rtt)
+            fps = batch * iters / elapsed
+            result[f"{tag}_fps"] = round(fps, 1)
+            if flops and peak:
+                result[f"{tag}_mfu"] = round(
+                    flops * iters / elapsed / peak, 4)
     result["detect_resolution"] = 640
     return result
 
@@ -449,6 +459,41 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
             result[f"llm_longctx8k_{impl}_error"] = \
                 f"{type(error).__name__}: {error}"[:200]
 
+    # -- flash kernel in isolation: % of chip peak on the fully-live
+    # causal region (last 2k chunk of an 8k prompt, llama3-1b heads).
+    if peak:
+        try:
+            from aiko_services_tpu.ops.pallas_attention import \
+                flash_attention
+            fs, ft = 2048, 8192
+            fq = jax.random.normal(jax.random.PRNGKey(7),
+                                   (1, fs, 32, 64), jnp.bfloat16)
+            fk = jax.random.normal(jax.random.PRNGKey(8),
+                                   (1, ft, 8, 64), jnp.bfloat16)
+            fv = jax.random.normal(jax.random.PRNGKey(9),
+                                   (1, ft, 8, 64), jnp.bfloat16)
+            fiters = 50
+
+            @jax.jit
+            def flash_loop(fq, fk, fv):
+                def body(i, acc):
+                    out = flash_attention(
+                        fq + (i * 1e-6).astype(fq.dtype), fk, fv,
+                        q_offset=ft - fs)
+                    return acc + out.astype(jnp.float32).sum()
+                return lax.fori_loop(0, fiters, body, jnp.float32(0.0))
+
+            float(flash_loop(fq, fk, fv))           # compile + warm
+            elapsed = time_device_loop(
+                lambda: float(flash_loop(fq, fk, fv)), rtt)
+            attended = sum(range(ft - fs + 1, ft + 1))
+            fl = 4 * 32 * 64 * attended
+            result["flash_kernel_pct_peak"] = round(
+                fl * fiters / elapsed / peak * 100, 1)
+        except Exception as error:
+            result["flash_kernel_error"] = \
+                f"{type(error).__name__}: {error}"[:200]
+
     # -- end-to-end serving host loop (RTT-bound through the tunnel) -----
     batcher = ContinuousBatcher(params, config, max_slots=slots,
                                 max_seq=max_seq, prefill_chunk=chunk)
@@ -567,6 +612,7 @@ def bench_pipeline_e2e() -> dict:
     pump(E2E_WARMUP)                         # compiles detector + LLM
     runtime.run(until=lambda: drain(E2E_WARMUP), timeout=600.0)
     if len(collected) < E2E_WARMUP:
+        runtime.terminate()
         return {"pipeline_e2e_error": "warmup stalled"}
     collected.clear()
 
