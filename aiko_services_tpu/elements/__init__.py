@@ -15,7 +15,7 @@ from .audio import (AudioReadFile, AudioWriteFile, AudioFraming,
                     write_wav)
 from .audio_live import (MicrophoneRead, SpeakerWrite, DataSchemeMic,
                          DataSchemeSpeaker)
-from .scheme_rtsp import DataSchemeRTSP, VideoReadRTSP
+from .scheme_rtsp import DataSchemeRTSP, VideoReadRTSP, VideoWriteRTSP
 from .detect import Detector
 from .vision import FaceDetect, ArucoMarkerDetect
 from .llm import LLM, LLMService, PROTOCOL_LLM

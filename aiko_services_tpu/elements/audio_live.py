@@ -111,12 +111,14 @@ class _PlaybackPump:
     the process for the playback duration.  The pump mirrors the capture
     pattern: the engine enqueues, a daemon thread drains."""
 
-    def __init__(self, backend, queue_depth: int = 64):
+    def __init__(self, backend, queue_depth: int = 64,
+                 label: str = "speaker"):
         self._backend = backend
+        self._label = label
         self._queue: queue.Queue = queue.Queue(maxsize=queue_depth)
         self._error: Exception | None = None
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name="aiko.speaker.pump")
+            target=self._run, daemon=True, name=f"aiko.{label}.pump")
         self._thread.start()
 
     def _run(self):
@@ -131,16 +133,32 @@ class _PlaybackPump:
         self._backend.close()       # sole closer: never races a write()
 
     def write(self, samples: np.ndarray, timeout: float = 1.0):
-        if self._error is not None:
-            error, self._error = self._error, None
-            raise RuntimeError(f"speaker backend failed: {error}")
+        self._raise_backend_error()
         try:
             self._queue.put(samples, timeout=timeout)
         except queue.Full:
             raise RuntimeError(
-                "speaker backlog exceeded (producer faster than "
-                "real-time playback; add AudioSample or raise "
+                f"{self._label} backlog exceeded (producer faster than "
+                "the backend drains; sample/drop upstream or raise "
                 "queue_depth)") from None
+
+    def try_write(self, item) -> bool:
+        """Drop-on-full enqueue (video semantics: a slow encoder drops
+        frames rather than stalling or erroring the stream).  Returns
+        False when the frame was dropped; raises only for backend
+        failures."""
+        self._raise_backend_error()
+        try:
+            self._queue.put_nowait(item)
+            return True
+        except queue.Full:
+            return False
+
+    def _raise_backend_error(self):
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise RuntimeError(
+                f"{self._label} backend failed: {error}")
 
     def close(self):
         """Signal the pump to finish and close the backend.  The backend

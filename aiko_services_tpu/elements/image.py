@@ -37,13 +37,23 @@ def image_to_array(image) -> np.ndarray:
     return array
 
 
+def as_uint8(image) -> np.ndarray:
+    """Any array-like image -> uint8 (floats treated as 0..1 and
+    scaled; integer types cast).  The one conversion every image
+    writer/detector backend shares."""
+    array = np.asarray(image)
+    if array.dtype == np.uint8:
+        return array
+    if array.dtype.kind == "f":
+        return (np.clip(array, 0.0, 1.0) * 255).astype(np.uint8)
+    return array.astype(np.uint8)
+
+
 def array_to_image(array):
     """numpy/jax array [H, W, C] (uint8 or float 0..1) -> PIL Image."""
     if not _HAVE_PIL:
         raise RuntimeError("Pillow is not installed")
-    array = np.asarray(array)
-    if array.dtype != np.uint8:
-        array = (np.clip(array, 0.0, 1.0) * 255).astype(np.uint8)
+    array = as_uint8(array)
     if array.ndim == 3 and array.shape[-1] == 1:
         array = array[:, :, 0]
     return Image.fromarray(array)

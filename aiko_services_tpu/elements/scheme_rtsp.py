@@ -1,31 +1,41 @@
-"""``rtsp://`` network-camera ingest (reference:
+"""``rtsp://`` network-video ingest AND output (reference:
 src/aiko_services/elements/gstreamer/scheme_rtsp.py:27 DataSchemeRTSP,
-rtsp_io.py:35 VideoReadRTSP -- an 843-LoC PyGObject/GStreamer subsystem).
+rtsp_io.py:35 VideoReadRTSP, video_stream_writer.py:26 VideoStreamWriter
++ utilities.py:27-100 H264 codec selection -- an 843-LoC
+PyGObject/GStreamer subsystem).
 
-Here decode rides cv2's bundled FFMPEG backend (``cv2.VideoCapture``
-opens RTSP URLs directly): no GStreamer dependency, same capability --
+Ingest rides cv2's bundled FFMPEG backend (``cv2.VideoCapture`` opens
+RTSP URLs directly): no GStreamer dependency, same capability --
 network cameras feed the Detector.  Frames decode on the source pump
 thread host-side and enter the pipeline as jax arrays; resize/normalize
 run on device downstream.
 
-``capture_factory`` is an injectable module hook (default
-``cv2.VideoCapture``) so tests drive the scheme with fake captures and
-deployments can substitute a GStreamer/ffmpeg-subprocess reader without
-touching the element.
+Output pushes H264 over RTSP through an ffmpeg subprocess (rawvideo
+RGB on stdin -> libx264 zerolatency -> ``rtsp://`` publish), the
+ffmpeg-CLI equivalent of the reference's appsrc -> x264enc GStreamer
+chain.
+
+``capture_factory`` / ``writer_factory`` are injectable module hooks
+(defaults: ``cv2.VideoCapture`` / the ffmpeg subprocess) so tests drive
+the scheme with fakes and deployments can substitute GStreamer or a
+hardware encoder without touching the elements.
 """
 
 from __future__ import annotations
 
+import subprocess
 import threading
 
 import numpy as np
 
 import jax.numpy as jnp
 
-from ..pipeline import DataScheme, DataSource, StreamEvent
+from ..pipeline import DataScheme, DataSource, DataTarget, StreamEvent
 from ..pipeline.stream import Stream
+from .image import as_uint8
 
-__all__ = ["DataSchemeRTSP", "VideoReadRTSP", "capture_factory"]
+__all__ = ["DataSchemeRTSP", "VideoReadRTSP", "VideoWriteRTSP",
+           "capture_factory", "writer_factory"]
 
 
 class _CaptureGuard:
@@ -98,6 +108,48 @@ def _default_capture_factory(url: str):
 capture_factory = _default_capture_factory
 
 
+class _FfmpegWriter:
+    """H264/RTSP publisher: raw RGB frames on an ffmpeg subprocess's
+    stdin, x264 zerolatency encode, RTSP push to the URL (an RTSP
+    server -- e.g. mediamtx -- must be listening there, the same
+    contract as the reference's udpsink/rtmpsink targets)."""
+
+    def __init__(self, url: str, width: int, height: int, fps: float):
+        self._process = subprocess.Popen(
+            ["ffmpeg", "-loglevel", "error", "-f", "rawvideo",
+             "-pix_fmt", "rgb24", "-s", f"{width}x{height}",
+             "-r", str(fps), "-i", "-",
+             "-c:v", "libx264", "-preset", "ultrafast",
+             "-tune", "zerolatency", "-pix_fmt", "yuv420p",
+             "-f", "rtsp", url],
+            stdin=subprocess.PIPE)
+
+    def write(self, rgb_frame: np.ndarray):
+        self._process.stdin.write(
+            np.ascontiguousarray(rgb_frame, dtype=np.uint8).tobytes())
+
+    def close(self):
+        try:
+            self._process.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass                    # encoder already gone
+        try:
+            self._process.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            # ffmpeg wedged pushing to an unreachable server: a leaked
+            # encoder per stream restart otherwise.
+            self._process.kill()
+            self._process.wait()
+
+
+def _default_writer_factory(url: str, width: int, height: int,
+                            fps: float):
+    return _FfmpegWriter(url, width, height, fps)
+
+
+writer_factory = _default_writer_factory
+
+
 @DataScheme.register("rtsp")
 class DataSchemeRTSP(DataScheme):
     """Opens the stream URL and pumps decoded frames as ``image``s."""
@@ -150,6 +202,79 @@ class DataSchemeRTSP(DataScheme):
         guard = stream.variables.pop(self._key, None)
         if guard is not None:
             guard.release()
+
+    # -- output side (reference video_stream_writer.py:26) ----------------
+
+    @property
+    def _target_key(self) -> str:
+        return f"{self.element.name}.rtsp_writer"
+
+    def create_targets(self, stream: Stream, data_targets):
+        if len(data_targets) != 1:
+            return StreamEvent.ERROR, {
+                "diagnostic": f"rtsp:// takes exactly one target URL "
+                              f"per element, got {len(data_targets)}"}
+        # The writer needs the frame geometry, so it opens lazily on
+        # the first written frame; stash the URL for write().
+        stream.variables[self._target_key + ".url"] = data_targets[0]
+        return StreamEvent.OKAY, {}
+
+    def write(self, stream: Stream, image, fps: float = 30.0) -> bool:
+        """Publish one frame (any array-like HxWx3 RGB).  Returns False
+        when the frame was dropped because the encoder is behind (video
+        drop semantics -- a stalled RTSP server must never stall the
+        engine thread, the same contract _CaptureGuard keeps on the
+        ingest side; the pump thread absorbs the blocking pipe write).
+        Raises ValueError on a mid-stream geometry change: the encoder
+        is told the frame size once, and a different byte count would
+        silently misframe every later frame into garbage."""
+        from .audio_live import _PlaybackPump
+
+        frame = as_uint8(image)
+        pump = stream.variables.get(self._target_key)
+        if pump is None:
+            url = stream.variables[self._target_key + ".url"]
+            writer = writer_factory(url, frame.shape[1], frame.shape[0],
+                                    fps)
+            pump = _PlaybackPump(writer, queue_depth=30, label="rtsp")
+            stream.variables[self._target_key] = pump
+            stream.variables[self._target_key + ".shape"] = frame.shape
+        expected = stream.variables[self._target_key + ".shape"]
+        if frame.shape != expected:
+            raise ValueError(
+                f"rtsp frame geometry changed mid-stream: "
+                f"{frame.shape} vs encoder's {expected}")
+        return pump.try_write(frame)
+
+    def destroy_targets(self, stream: Stream):
+        stream.variables.pop(self._target_key + ".url", None)
+        stream.variables.pop(self._target_key + ".shape", None)
+        pump = stream.variables.pop(self._target_key, None)
+        if pump is not None:
+            pump.close()        # closes the writer on the pump thread
+
+
+class VideoWriteRTSP(DataTarget):
+    """H264/RTSP output DataTarget: ``data_targets: rtsp://host/path``;
+    publishes each frame's ``image`` to the stream URL and passes it
+    through (reference video_stream_writer.py:26 VideoStreamWriter /
+    video_io's VideoWriteFile shape).  Parameter ``rate`` sets the
+    encoder's nominal fps (default 30)."""
+
+    def process_frame(self, stream: Stream, image=None, **inputs):
+        scheme = self.scheme_for(stream)
+        if scheme is None or image is None:
+            return StreamEvent.ERROR, {
+                "diagnostic": "rtsp target not initialized or no image"}
+        rate, _ = self.get_parameter("rate", 30.0)
+        try:
+            written = scheme.write(stream, image, fps=float(rate))
+        except (OSError, ValueError, RuntimeError) as error:
+            return StreamEvent.ERROR, {
+                "diagnostic": f"rtsp publish failed: {error}"}
+        if not written:
+            self.logger.warning("rtsp encoder behind; frame dropped")
+        return StreamEvent.OKAY, {"image": image, **inputs}
 
 
 class VideoReadRTSP(DataSource):

@@ -19,6 +19,7 @@ except ImportError:                                 # pragma: no cover
 import jax.numpy as jnp
 
 from ..pipeline import DataSource, DataTarget, PipelineElement, StreamEvent
+from .image import as_uint8 as _as_uint8
 from .scheme_file import DataSchemeFile
 
 __all__ = ["VideoReadFile", "VideoWriteFile", "VideoSample",
@@ -76,9 +77,7 @@ class VideoWriteFile(DataTarget):
             return StreamEvent.ERROR, {
                 "diagnostic": "VideoWriteFile requires file:// targets"}
         writer = stream.variables.get("video_writer")
-        array = np.asarray(image)
-        if array.dtype != np.uint8:
-            array = (np.clip(array, 0.0, 1.0) * 255).astype(np.uint8)
+        array = _as_uint8(image)
         if writer is None:
             path = scheme.target_path(stream)
             codec, _ = self.get_parameter("codec", "MJPG")

@@ -39,12 +39,7 @@ def _to_gray(array: np.ndarray) -> np.ndarray:
     return cv2.cvtColor(array, cv2.COLOR_RGB2GRAY)
 
 
-def _as_uint8(image) -> np.ndarray:
-    array = np.asarray(image)
-    if array.dtype != np.uint8:
-        array = (np.clip(array, 0.0, 1.0) * 255).astype(np.uint8) \
-            if array.dtype.kind == "f" else array.astype(np.uint8)
-    return array
+from .image import as_uint8 as _as_uint8
 
 
 class _CascadeBackend:

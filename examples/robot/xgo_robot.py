@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""XGORobot: the real-hardware robot-dog actor (reference:
+examples/xgo_robot/xgo_robot.py:110-221 XGORobot / XGORobotImpl, which
+drives an XGO-Mini over serial via ``xgolib.XGO('/dev/ttyAMA0')``).
+
+The serial layer is an injectable module hook (``xgo_factory``):
+tests drive the actor with a mock backend asserting the exact command
+traffic; on a robot the default factory opens the real xgolib port.
+Every reference command (action/arm/arm_mode/attitude/body_mode/claw/
+move/reset/stop/translation/turn) is exposed as an Actor method --
+remotely callable by proxy over the fabric, exactly like the
+reference's MQTT function calls from robot_control.py -- with the
+reference's documented range clamps applied before they reach the
+serial line.  A battery monitor timer mirrors
+``BATTERY_MONITOR_PERIOD`` (xgo_robot.py:22) into the ``share`` dict
+so the Dashboard shows charge state live.
+
+Run on a robot::
+
+    python examples/robot/xgo_robot.py          # + aiko_dashboard
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+from aiko_services_tpu.services import Actor
+
+PROTOCOL_XGO = "xgo_robot:0"
+
+BATTERY_MONITOR_PERIOD = 10.0          # reference xgo_robot.py:22
+ACTIONS = ("crawl", "pee", "sit", "sniff", "stretch", "wiggle_tail")
+
+# Reference range comments (xgo_robot.py:115-180), clamped here so a
+# bad remote command can never reach the serial line out of range.
+RANGES = {
+    "arm_x": (-80, 155), "arm_z": (-95, 155),
+    "pitch": (-15, 15), "roll": (-20, 10), "yaw": (-11, 11),
+    "stride_x": (-25, 25), "stride_y": (-18, 18),
+    "translation_x": (-35, 35), "translation_y": (-18, 18),
+    "translation_z": (75, 115),
+    "turn": (-100, 100), "claw": (0, 255),
+}
+
+
+def _clamp(name: str, value) -> int:
+    low, high = RANGES[name]
+    return int(min(max(float(value), low), high))
+
+
+def _default_xgo_factory(port: str = "/dev/ttyAMA0",
+                         version: str = "xgomini"):
+    try:
+        from xgolib import XGO                      # on-robot only
+    except ImportError as error:
+        raise RuntimeError(
+            "xgolib not installed -- run on the robot, or inject a "
+            "backend via examples.robot.xgo_robot.xgo_factory") \
+            from error
+    return XGO(port=port, version=version)
+
+
+xgo_factory = _default_xgo_factory
+
+
+class XGORobot(Actor):
+    """Serial-attached XGO robot-dog (reference XGORobotImpl)."""
+
+    def __init__(self, name="xgo_robot", runtime=None, backend=None,
+                 port: str = "/dev/ttyAMA0"):
+        super().__init__(name, PROTOCOL_XGO, tags=["ec=true"],
+                         runtime=runtime)
+        self._xgo = backend if backend is not None \
+            else xgo_factory(port)
+        self.share.update({
+            "battery": -1,
+            "version_firmware": str(getattr(
+                self._xgo, "read_firmware", lambda: "v0")()),
+            "last_action": "none",
+        })
+        self._battery_timer = self.runtime.engine.add_timer_handler(
+            self._battery_monitor, BATTERY_MONITOR_PERIOD)
+
+    # -- command surface (each remotely callable by proxy) -----------------
+
+    def action(self, value):
+        if value not in ACTIONS:
+            self.logger.warning("unknown action %r", value)
+            return
+        self._xgo.action(value)
+        self.ec_producer.update("last_action", value)
+
+    def arm(self, x, z):
+        self._xgo.arm(_clamp("arm_x", x), _clamp("arm_z", z))
+
+    def arm_mode(self, stabilize):
+        self._xgo.arm_mode(str(stabilize).lower() == "true")
+
+    def attitude(self, pitch="nil", roll="nil", yaw="nil"):
+        for axis, value in (("pitch", pitch), ("roll", roll),
+                            ("yaw", yaw)):
+            if value != "nil":
+                self._xgo.attitude(axis, _clamp(axis, value))
+
+    def body_mode(self, stabilize):
+        self._xgo.body_mode(str(stabilize).lower() == "true")
+
+    def claw(self, grip):
+        self._xgo.claw(_clamp("claw", grip))
+
+    def move(self, direction, stride="nil"):
+        if direction not in ("x", "y"):
+            self.logger.warning("move direction %r not x|y", direction)
+            return
+        if stride != "nil":
+            self._xgo.move(direction, _clamp(f"stride_{direction}",
+                                             stride))
+
+    def reset(self):
+        self._xgo.reset()
+
+    def stop(self):
+        self._xgo.stop()
+
+    def translation(self, x="nil", y="nil", z="nil"):
+        for axis, value in (("x", x), ("y", y), ("z", z)):
+            if value != "nil":
+                self._xgo.translation(axis,
+                                      _clamp(f"translation_{axis}",
+                                             value))
+
+    def turn(self, speed):
+        self._xgo.turn(_clamp("turn", speed))
+
+    def terminate(self, immediate=False):
+        self.runtime.engine.remove_timer_handler(self._battery_timer)
+        self._xgo.stop()
+        self.runtime.engine.terminate()
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _battery_monitor(self):
+        read = getattr(self._xgo, "read_battery", None)
+        if read is not None:
+            self.ec_producer.update("battery", int(read()))
+
+
+def main():
+    from aiko_services_tpu.runtime import init_process
+
+    runtime = init_process()
+    runtime.initialize()
+    XGORobot(runtime=runtime)
+    runtime.run()
+
+
+if __name__ == "__main__":
+    main()

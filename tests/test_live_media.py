@@ -289,3 +289,128 @@ def test_playback_pump_backlog_raises():
         raised = True
         assert "backlog" in str(error)
     assert raised
+
+
+# -- rtsp:// output (reference video_stream_writer.py:26) -------------------
+
+class FakeWriter:
+    """Records published frames in place of the ffmpeg subprocess."""
+    instances: list = []
+
+    def __init__(self, url, width, height, fps):
+        self.url, self.width, self.height, self.fps = (url, width,
+                                                       height, fps)
+        self.frames: list = []
+        self.closed = False
+        FakeWriter.instances.append(self)
+
+    def write(self, frame):
+        self.frames.append(np.array(frame))
+
+    def close(self):
+        self.closed = True
+
+
+def test_rtsp_target_publishes_frames(runtime, monkeypatch):
+    """VideoWriteRTSP opens the writer lazily with the first frame's
+    geometry, publishes every frame as uint8 RGB, passes images
+    through, and closes the writer at stream stop."""
+    monkeypatch.setattr(scheme_rtsp, "writer_factory", FakeWriter)
+    FakeWriter.instances.clear()
+
+    pipeline = Pipeline(definition(
+        ["(Out)"],
+        [element("Out", "VideoWriteRTSP", ["image"], ["image"],
+                 {"data_targets": "rtsp://server.local/live",
+                  "rate": 15})],
+        name="p_rtsp_out"), runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("s1", queue_response=responses)
+    for i in range(3):
+        pipeline.create_frame_local(
+            stream, {"image": np.full((4, 6, 3), 0.25 * (i + 1),
+                                      np.float32)})
+    done = []
+
+    def drain():
+        while not responses.empty():
+            *_, okay, _diag = responses.get()
+            done.append(okay)
+        return len(done) >= 3
+    assert run_until(runtime, drain, timeout=15.0)
+    assert all(done)
+
+    writer = FakeWriter.instances[0]
+    assert (writer.url, writer.width, writer.height, writer.fps) \
+        == ("rtsp://server.local/live", 6, 4, 15.0)
+    # Writes drain on the pump thread (engine never blocks on the
+    # encoder pipe) -- wait for the async drain.
+    assert run_until(runtime, lambda: len(writer.frames) >= 3,
+                     timeout=10.0)
+    assert writer.frames[0].dtype == np.uint8
+    assert int(writer.frames[0][0, 0, 0]) == 63        # 0.25 * 255
+    assert not writer.closed
+
+    pipeline.destroy_stream("s1")
+    assert run_until(runtime, lambda: writer.closed, timeout=10.0)
+
+
+def test_rtsp_target_write_failure_errors_frame(runtime, monkeypatch):
+    """A dead publisher (broken pipe on the pump thread) surfaces as a
+    frame ERROR on a subsequent frame, never a crash or an engine
+    stall."""
+    class BrokenWriter(FakeWriter):
+        def write(self, frame):
+            raise BrokenPipeError("encoder died")
+
+    monkeypatch.setattr(scheme_rtsp, "writer_factory", BrokenWriter)
+    FakeWriter.instances.clear()
+    pipeline = Pipeline(definition(
+        ["(Out)"],
+        [element("Out", "VideoWriteRTSP", ["image"], ["image"],
+                 {"data_targets": "rtsp://server.local/live"})],
+        name="p_rtsp_broken"), runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("s1", queue_response=responses)
+    failures = []
+
+    def push_and_check():
+        pipeline.create_frame_local(
+            stream, {"image": np.zeros((2, 2, 3), np.uint8)})
+        while not responses.empty():
+            *_, okay, diagnostic = responses.get()
+            if not okay:
+                failures.append(diagnostic)
+        return bool(failures)
+
+    assert run_until(runtime, push_and_check, timeout=15.0)
+    assert "rtsp publish failed" in failures[0]
+
+
+def test_rtsp_target_rejects_geometry_change(runtime, monkeypatch):
+    """The encoder is told the frame size once; a mid-stream resolution
+    change must ERROR the frame, not silently misframe the video."""
+    monkeypatch.setattr(scheme_rtsp, "writer_factory", FakeWriter)
+    FakeWriter.instances.clear()
+    pipeline = Pipeline(definition(
+        ["(Out)"],
+        [element("Out", "VideoWriteRTSP", ["image"], ["image"],
+                 {"data_targets": "rtsp://server.local/live"})],
+        name="p_rtsp_geom"), runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("s1", queue_response=responses)
+    pipeline.create_frame_local(
+        stream, {"image": np.zeros((4, 4, 3), np.uint8)})
+    pipeline.create_frame_local(
+        stream, {"image": np.zeros((8, 8, 3), np.uint8)})
+    results = []
+
+    def drain():
+        while not responses.empty():
+            *_, okay, diagnostic = responses.get()
+            results.append((okay, diagnostic))
+        return len(results) >= 2
+    assert run_until(runtime, drain, timeout=10.0)
+    assert results[0][0]
+    assert not results[1][0]
+    assert "geometry changed" in results[1][1]

@@ -98,3 +98,73 @@ def test_fusion_memory_decays(runtime):
     assert views[0] == ["oak_tree"]
     assert views[7] == ["oak_tree"]       # still remembered
     assert views[8] == []                 # decayed after 8 frames
+
+
+# -- hardware XGO actor (reference xgo_robot.py:110-221) --------------------
+
+class MockXgoBackend:
+    """Records the serial-command traffic the actor would send."""
+
+    def __init__(self):
+        self.calls = []
+        self.battery = 87
+
+    def __getattr__(self, name):
+        def record(*args):
+            self.calls.append((name,) + args)
+        return record
+
+    def read_battery(self):
+        return self.battery
+
+    def read_firmware(self):
+        return "v1.2.3"
+
+
+def load_xgo_module():
+    spec = importlib.util.spec_from_file_location(
+        "xgo_robot_test", ROBOT_DIR / "xgo_robot.py")
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_xgo_actor_commands_reach_serial_backend(runtime):
+    """Remote command calls land on the injected serial backend with
+    the reference's range clamps applied."""
+    from aiko_services_tpu.services import Registrar, get_service_proxy
+
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    module = load_xgo_module()
+    backend = MockXgoBackend()
+    robot = module.XGORobot(runtime=runtime, backend=backend)
+    assert robot.share["version_firmware"] == "v1.2.3"
+
+    proxy = get_service_proxy(runtime, robot.topic_path)
+    proxy.arm(200, -200)              # out of range both axes
+    proxy.claw(300)
+    proxy.move("x", 99)
+    proxy.turn(-250)
+    proxy.attitude(5, "nil", 99)
+    proxy.action("sit")
+    proxy.action("backflip")          # unknown: must NOT reach serial
+    assert run_until(
+        runtime, lambda: ("action", "sit") in backend.calls,
+        timeout=10.0)
+    assert ("arm", 155, -95) in backend.calls          # clamped
+    assert ("claw", 255) in backend.calls
+    assert ("move", "x", 25) in backend.calls
+    assert ("turn", -100) in backend.calls
+    assert ("attitude", "pitch", 5) in backend.calls
+    assert ("attitude", "yaw", 11) in backend.calls
+    assert ("action", "sit") in backend.calls
+    assert not any(call[0] == "action" and call[1] == "backflip"
+                   for call in backend.calls)
+    assert run_until(
+        runtime, lambda: robot.share.get("last_action") == "sit",
+        timeout=10.0)
+
+    robot._battery_monitor()          # timer body (period is 10 s)
+    assert run_until(
+        runtime, lambda: robot.share.get("battery") == 87, timeout=10.0)
