@@ -113,7 +113,8 @@ class _PlaybackPump:
 
     def __init__(self, backend, queue_depth: int = 64,
                  label: str = "speaker"):
-        self._backend = backend
+        self.backend = backend      # public: callers may force-kill a
+        self._backend = backend     # wedged backend after close()
         self._label = label
         self._queue: queue.Queue = queue.Queue(maxsize=queue_depth)
         self._error: Exception | None = None
@@ -165,7 +166,10 @@ class _PlaybackPump:
         close always happens on the pump thread -- sounddevice/PortAudio
         stream ops are not safe concurrently with an in-flight write --
         so a stalled write can at worst leak the daemon thread, never
-        crash native code.  Bounded wait for the normal drain case."""
+        crash native code.  Bounded wait for the normal drain case;
+        returns False when the thread is still wedged in a write (the
+        caller may then force-kill ``self.backend`` if the backend
+        supports it -- see the rtsp target scheme)."""
         try:
             self._queue.put_nowait(None)
         except queue.Full:          # drop queued audio on shutdown
@@ -176,6 +180,7 @@ class _PlaybackPump:
                 pass
             self._queue.put(None)
         self._thread.join(timeout=2.0)
+        return not self._thread.is_alive()
 
 
 @DataScheme.register("mic")

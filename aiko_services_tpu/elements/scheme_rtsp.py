@@ -131,15 +131,21 @@ class _FfmpegWriter:
     def close(self):
         try:
             self._process.stdin.close()
-        except (BrokenPipeError, OSError):
-            pass                    # encoder already gone
+        except (BrokenPipeError, OSError, ValueError):
+            pass                    # encoder already gone / double close
         try:
             self._process.wait(timeout=5.0)
         except subprocess.TimeoutExpired:
             # ffmpeg wedged pushing to an unreachable server: a leaked
             # encoder per stream restart otherwise.
-            self._process.kill()
-            self._process.wait()
+            self.kill()
+
+    def kill(self):
+        """Hard-stop the encoder (idempotent, any-thread safe: Popen
+        ops take internal locks).  A kill also unblocks a pump thread
+        stuck in write() -- the pipe breaks, the thread drains out."""
+        self._process.kill()
+        self._process.wait()
 
 
 def _default_writer_factory(url: str, width: int, height: int,
@@ -250,8 +256,13 @@ class DataSchemeRTSP(DataScheme):
         stream.variables.pop(self._target_key + ".url", None)
         stream.variables.pop(self._target_key + ".shape", None)
         pump = stream.variables.pop(self._target_key, None)
-        if pump is not None:
-            pump.close()        # closes the writer on the pump thread
+        if pump is not None and not pump.close():
+            # Pump thread wedged inside a stalled pipe write: the
+            # encoder must be hard-stopped or it leaks per restart
+            # (the kill breaks the pipe, which also frees the thread).
+            kill = getattr(pump.backend, "kill", None)
+            if kill is not None:
+                kill()
 
 
 class VideoWriteRTSP(DataTarget):

@@ -33,7 +33,16 @@ from aiko_services_tpu.services import Actor
 PROTOCOL_XGO = "xgo_robot:0"
 
 BATTERY_MONITOR_PERIOD = 10.0          # reference xgo_robot.py:22
-ACTIONS = ("crawl", "pee", "sit", "sniff", "stretch", "wiggle_tail")
+
+# xgolib's numeric action ids (the serial protocol's contract; the
+# reference carries the same table, xgo_robot.py:27-34).
+ACTIONS = {
+    "fall": 1, "stand": 2, "crawl": 3, "circle": 4, "step": 5,
+    "squat": 6, "roll": 7, "pitch": 8, "yaw": 9, "roll_pitch_yaw": 10,
+    "pee": 11, "sit": 12, "beckon": 13, "stretch": 14, "wave": 15,
+    "wiggle_body": 16, "wiggle_tail": 17, "sniff": 18, "shake_paw": 19,
+    "arm": 20,
+}
 
 # Reference range comments (xgo_robot.py:115-180), clamped here so a
 # bad remote command can never reach the serial line out of range.
@@ -91,7 +100,7 @@ class XGORobot(Actor):
         if value not in ACTIONS:
             self.logger.warning("unknown action %r", value)
             return
-        self._xgo.action(value)
+        self._xgo.action(ACTIONS[value])    # xgolib takes numeric ids
         self.ec_producer.update("last_action", value)
 
     def arm(self, x, z):
@@ -104,7 +113,9 @@ class XGORobot(Actor):
         for axis, value in (("pitch", pitch), ("roll", roll),
                             ("yaw", yaw)):
             if value != "nil":
-                self._xgo.attitude(axis, _clamp(axis, value))
+                # xgolib's attitude(direction, data) takes the
+                # single-letter direction ('p'/'r'/'y').
+                self._xgo.attitude(axis[0], _clamp(axis, value))
 
     def body_mode(self, stabilize):
         self._xgo.body_mode(str(stabilize).lower() == "true")

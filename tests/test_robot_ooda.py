@@ -150,16 +150,17 @@ def test_xgo_actor_commands_reach_serial_backend(runtime):
     proxy.action("sit")
     proxy.action("backflip")          # unknown: must NOT reach serial
     assert run_until(
-        runtime, lambda: ("action", "sit") in backend.calls,
+        runtime, lambda: ("action", 12) in backend.calls,
         timeout=10.0)
     assert ("arm", 155, -95) in backend.calls          # clamped
     assert ("claw", 255) in backend.calls
     assert ("move", "x", 25) in backend.calls
     assert ("turn", -100) in backend.calls
-    assert ("attitude", "pitch", 5) in backend.calls
-    assert ("attitude", "yaw", 11) in backend.calls
-    assert ("action", "sit") in backend.calls
-    assert not any(call[0] == "action" and call[1] == "backflip"
+    # xgolib serial contract: single-letter attitude directions and
+    # numeric action ids ("sit" = 12).
+    assert ("attitude", "p", 5) in backend.calls
+    assert ("attitude", "y", 11) in backend.calls
+    assert not any(call[0] == "action" and call[1] != 12
                    for call in backend.calls)
     assert run_until(
         runtime, lambda: robot.share.get("last_action") == "sit",
