@@ -114,8 +114,7 @@ class _PlaybackPump:
     def __init__(self, backend, queue_depth: int = 64,
                  label: str = "speaker"):
         self.backend = backend      # public: callers may force-kill a
-        self._backend = backend     # wedged backend after close()
-        self._label = label
+        self._label = label         # wedged backend after close()
         self._queue: queue.Queue = queue.Queue(maxsize=queue_depth)
         self._error: Exception | None = None
         self._thread = threading.Thread(
@@ -128,10 +127,10 @@ class _PlaybackPump:
             if samples is None:
                 break
             try:
-                self._backend.write(samples)
+                self.backend.write(samples)
             except Exception as error:
                 self._error = error
-        self._backend.close()       # sole closer: never races a write()
+        self.backend.close()        # sole closer: never races a write()
 
     def write(self, samples: np.ndarray, timeout: float = 1.0):
         self._raise_backend_error()

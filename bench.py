@@ -459,6 +459,51 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
             result[f"llm_longctx8k_{impl}_error"] = \
                 f"{type(error).__name__}: {error}"[:200]
 
+    # -- long-context decode: at 8 slots x 8k context the KV cache
+    # (2.1 GB bf16) outweighs the int8 weights (1.24 GB), so the int8
+    # cache (kv_dtype, models/quant.py:quantize_kv) directly cuts the
+    # dominant byte stream.  Both runs use int8 weights (the serving
+    # config); the cache matmuls run as native int8 MXU dots
+    # (ops/layers.py attention_decode_append).
+    lc_slots, lc_ctx, lc_iters = 8, 8192, 64
+    lc_tokens_arr = jnp.asarray(
+        rng.integers(0, config.vocab_size, lc_slots), dtype=jnp.int32)
+    lc_lengths = jnp.full((lc_slots,), lc_ctx - lc_iters - 1,
+                          dtype=jnp.int32)
+    qp = quantize_params(params)
+    for kv_tag, kv_dtype in (("bf16kv", "bfloat16"),
+                             ("int8kv", "int8")):
+        lc_config = dataclasses.replace(config, max_seq=lc_ctx,
+                                        kv_dtype=kv_dtype)
+
+        @jax.jit
+        def lc_decode_loop(qp, tokens, cache, lengths):
+            def body(carry, _):
+                tokens, cache, lengths = carry
+                logits, cache = llama.decode_step.__wrapped__(
+                    qp, lc_config, tokens, cache, lengths)
+                tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (tokens, cache, lengths + 1), None
+            (tokens, cache, _), _ = lax.scan(
+                body, (tokens, cache, lengths), None, length=lc_iters)
+            return tokens.sum()
+
+        lc_cache = llama.init_cache(lc_config, lc_slots, lc_ctx)
+        int(lc_decode_loop(qp, lc_tokens_arr, lc_cache, lc_lengths))
+        lc_cache = llama.init_cache(lc_config, lc_slots, lc_ctx)
+        elapsed = time_device_loop(
+            lambda: int(lc_decode_loop(qp, lc_tokens_arr, lc_cache,
+                                       lc_lengths)), rtt)
+        result[f"llm_decode8k_{kv_tag}_step_ms"] = round(
+            elapsed / lc_iters * 1000, 3)
+        if hbm_peak:
+            lc_bytes = decode_bytes(qp) - cache_bytes \
+                + tree_bytes(lc_cache)
+            result[f"llm_decode8k_{kv_tag}_hbm_util"] = round(
+                lc_bytes * lc_iters / elapsed / hbm_peak, 3)
+        del lc_cache
+    del qp
+
     # -- flash kernel in isolation: % of chip peak on the fully-live
     # causal region (last 2k chunk of an 8k prompt, llama3-1b heads).
     if peak:

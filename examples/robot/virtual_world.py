@@ -76,6 +76,9 @@ class WorldState:
     def initial(cls, config: WorldConfig) -> "WorldState":
         spots = np.asarray([[3.0, 2.0], [-2.5, 3.5], [2.0, -3.0],
                             [-3.0, -2.0]], dtype=np.float32)
+        if config.n_obstacles > len(spots):
+            raise ValueError(f"n_obstacles <= {len(spots)} "
+                             f"(got {config.n_obstacles})")
         return cls(robot_xz=np.zeros(2, dtype=np.float32),
                    robot_heading=0.0,
                    ball_xz=np.asarray([2.5, 0.5], dtype=np.float32),

@@ -179,10 +179,13 @@ def test_tp_decode_with_quantized_tree():
 # -- int8 KV cache (VERDICT r2 item 4) -----------------------------------
 
 
-def test_kv_quantized_attention_is_exact_dequantization():
-    """The scale-folded quantized attention paths equal attention over
-    the explicitly dequantized cache to float32 rounding (the folding
-    is exact math, not an approximation)."""
+def test_kv_quantized_attention_matches_dequantized():
+    """Prefill over a quantized cache equals attention over the
+    explicitly dequantized cache to float rounding (the scale folding
+    is exact math).  The decode-append path ADDITIONALLY quantizes the
+    query and the softmax weights so both cache matmuls run as native
+    int8 MXU dots (ops/layers.py) -- bounded-approximate there, with
+    error at the int8 step size, not float rounding."""
     from aiko_services_tpu.ops.layers import (attention_decode_append,
                                               attention_prefill)
     key = jax.random.PRNGKey(0)
@@ -211,7 +214,7 @@ def test_kv_quantized_attention_is_exact_dequantization():
                                                v_new, lengths)),
             np.asarray(attention_decode_append(q[:, :1], kd, vd, k_new,
                                                v_new, lengths)),
-            atol=1e-5)
+            atol=3e-2)
 
 
 def test_kv_cache_int8_serving_paths():
