@@ -66,6 +66,11 @@ class LlamaConfig:
     # balanced share; overflow tokens fall back to their residual
     # stream (standard GShard semantics, keeps every shape static).
     capacity_factor: float = 2.0
+    # Rematerialize each layer's activations in the backward pass
+    # (jax.checkpoint around the scanned block): activation memory
+    # drops from O(layers) to O(1) layers at ~1/3 extra forward FLOPs
+    # -- the standard trade for long-sequence training.
+    remat: bool = False
 
     def __post_init__(self):
         if self.attention not in ("dense", "flash"):
@@ -408,6 +413,9 @@ def _forward_layers(params: dict, config: LlamaConfig, hidden,
         kv_write = kv_write_factory(k_layer, v_layer)
         hidden2, aux2 = _block(config, hidden, layer, kv_write)
         return (hidden2, aux + aux2), kv_write.updated
+
+    if config.remat:
+        layer_step = jax.checkpoint(layer_step)
 
     (hidden, aux), updates = jax.lax.scan(
         layer_step, (hidden, jnp.float32(0.0)),

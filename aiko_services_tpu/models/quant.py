@@ -66,11 +66,13 @@ def quantize_kv(x) -> dict:
 
     Decode streams the whole cache every step; int8 halves those bytes
     (the scale adds 1/head_dim).  The scale never enters the attention
-    matmuls: the score matmul contracts int8-cast-to-bf16 keys and the
-    per-position key scale multiplies the [B, H, T] logits afterwards,
-    and the value scale folds into the softmax weights before the
-    weighted sum -- exact, because each scale is constant along the
-    contracted head_dim axis (see ops/layers.py attention paths)."""
+    matmuls -- it is constant along the contracted head_dim, so key
+    scales multiply the score logits and value scales fold into the
+    softmax weights.  Prefill reads are exact dequantization; the
+    decode path additionally quantizes the query and the softmax
+    weights so both cache matmuls run as native int8 MXU dots --
+    bounded-approximate at the int8 step size (see ops/layers.py
+    attention_decode_append)."""
     x32 = x.astype(jnp.float32)
     scale = jnp.maximum(jnp.abs(x32).max(axis=-1, keepdims=True),
                         1e-8) / 127.0

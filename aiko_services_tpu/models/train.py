@@ -60,15 +60,58 @@ def init_train_state(key, config: llama.LlamaConfig, plan: MeshPlan,
 
 
 def make_train_step(config: llama.LlamaConfig, plan: MeshPlan,
-                    optimizer=None, learning_rate: float = 3e-4):
+                    optimizer=None, learning_rate: float = 3e-4,
+                    accumulate_steps: int = 1):
+    """Jitted sharded train step.
+
+    ``accumulate_steps`` > 1 splits the batch into that many
+    microbatches and averages their gradients inside one jit
+    (``lax.scan`` -- only one microbatch's activations are ever live),
+    so effective batch scales without activation memory; combine with
+    ``LlamaConfig(remat=True)`` to also drop per-layer activations.
+    The batch's leading dim must divide evenly.
+    """
     optimizer = optimizer or optax.adamw(learning_rate)
     param_shardings = jax.tree_util.tree_map(
         plan.shard, llama.partition_specs(config))
     batch_sharding = plan.shard(P(("dp", "fsdp"), None))
+    micro = max(1, int(accumulate_steps))
+
+    def batch_grads(params, tokens):
+        if micro == 1:
+            return jax.value_and_grad(language_model_loss)(
+                params, config, tokens)
+        batch = tokens.shape[0]
+        if batch % micro:
+            raise ValueError(f"batch {batch} not divisible by "
+                             f"accumulate_steps {micro}")
+        # Interleaved split (rows 0, micro, 2*micro... form microbatch
+        # 0): every microbatch stays evenly spread over the dp/fsdp
+        # shards of the batch axis, so no per-scan-step resharding --
+        # a contiguous split would land each microbatch on a fraction
+        # of the mesh.
+        microbatches = tokens.reshape(batch // micro, micro,
+                                      -1).swapaxes(0, 1)
+
+        def accumulate(carry, microbatch):
+            loss_sum, grad_sum = carry
+            loss, grads = jax.value_and_grad(language_model_loss)(
+                params, config, microbatch)
+            return (loss_sum + loss,
+                    jax.tree_util.tree_map(jnp.add, grad_sum, grads)), \
+                None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            accumulate, (jnp.float32(0.0), zeros), microbatches)
+        average = jax.tree_util.tree_map(lambda g: g / micro, grad_sum)
+        return loss_sum / micro, average
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(language_model_loss)(
-            params, config, tokens)
+        loss, grads = batch_grads(params, tokens)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), grads, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -115,6 +115,7 @@ def attention_prefill(q: jax.Array, k: jax.Array, v: jax.Array,
     v, v_scale = _split_kv(v)
     if k_scale is not None:
         k = k.astype(q.dtype)          # adjacent to the dot: fuses
+    if v_scale is not None:
         v = v.astype(q.dtype)
     scale = q.shape[-1] ** -0.5
     grouped = _group_queries(q, k.shape[2])        # [B,S,K,G,hd]
@@ -163,12 +164,16 @@ def attention_decode_append(q: jax.Array, k_cache: jax.Array,
     free: decode runs at ~2% MFU, bandwidth-bound.
 
     q: [B, 1, H, hd]; k_cache/v_cache: [B, T, K, hd] (grouped) -- or
-    int8-quantized layers (``{"int8", "scale"}``): the cache matmuls
-    contract the int8 payload cast in-flight to the compute dtype
-    (streaming half the bytes), the key scales multiply the [B, H, T]
-    logits, and the value scales fold into the softmax weights before
-    the weighted sum -- both exact, since each (position, kv-head)
-    scale is constant along the contracted axes; k_new/
+    int8-quantized layers (``{"int8", "scale"}``): both cache matmuls
+    then run as NATIVE int8 MXU dots so the cache streams int8 bytes
+    (casting it up costs real VPU time -- the convert does not fuse
+    into the dot).  That makes the quantized path bounded-approximate,
+    not exact: the query quantizes per (batch, head) for the score
+    dot, and the softmax weights (value scales folded) quantize for
+    the weighted sum, each adding error at its int8 step size (~0.4%
+    of the row maximum); the softmax denominator stays exact-float,
+    so weight truncation can only shrink the output, never inflate it
+    (see the inline sink-token analysis).  k_new/
     v_new: [B, 1, K, hd]; lengths: [B] valid cache positions (NOT
     counting the current token).  Returns [B, 1, H, hd].
     """
@@ -229,17 +234,17 @@ def attention_decode_append(q: jax.Array, k_cache: jax.Array,
                              1e-30) / 127.0
         w_int8 = jnp.clip(jnp.round(folded / w_step), 0,
                           127).astype(jnp.int8)
-        # The denominator must come from the SAME quantized weights as
-        # the numerator: a diffuse tail whose weights round to zero
-        # then drops from both, so quantization renormalizes the
-        # retained mixture instead of biasing the output toward zero
-        # (with an exact-float denominator the error is unbounded for
-        # long near-uniform attention).
-        # Guard: unwritten cache positions carry scale 0 (init_cache),
-        # and 0 * (step / 0) would be NaN; their weights are 0 anyway.
-        w_dequantized = w_int8.astype(jnp.float32) \
-            * (w_step / jnp.maximum(v_scale_h, 1e-30))
-        denominator = w_dequantized.sum(-1) + self_weights    # [B,H]
+        # The denominator stays EXACT (the float weights): positions
+        # whose folded weight rounds to zero lose their (sub-half-step)
+        # value contribution from the numerator but keep their weight
+        # in the normalizer, so the output can only shrink by the
+        # dropped mass -- never inflate.  The alternative (denominator
+        # from the quantized weights) renormalizes the diffuse-tail
+        # case but systematically INFLATES whenever a large-weight,
+        # small-value-norm position quantizes away -- and that shape
+        # is exactly the attention-sink token real LLMs produce on
+        # every step, so exact-denominator is the safe side.
+        denominator = cache_weights.sum(-1) + self_weights    # [B,H]
         fused = jnp.einsum(
             "bht,btc->bhc", w_int8, v_flat,
             preferred_element_type=jnp.int32).astype(jnp.float32) \

@@ -560,20 +560,23 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
     result["llm_serving_host_loop_tokens_per_sec"] = round(
         emitted["n"] / elapsed, 1)
 
-    # -- same loop with PIPELINED fused decode blocks: 32 decode steps
+    # -- same loop with PIPELINED fused decode blocks: 64 decode steps
     # per dispatch, 3 blocks in flight chained device-side, emitted
-    # tokens copied back asynchronously -- the tunnel RTT is hidden
-    # behind device compute instead of paid per block.
+    # tokens copied back asynchronously.  Each block retire costs one
+    # result-fetch round trip through the tunnel regardless of data
+    # size, so the block is sized to amortize it (64 measured ~20%
+    # over 32 at ~100 ms RTT; on a co-located chip the loop is
+    # device-bound and the size matters much less).
     def serve(serve_params, label):
         batcher = ContinuousBatcher(params=serve_params, config=config,
                                     max_slots=slots, max_seq=max_seq,
                                     prefill_chunk=chunk,
-                                    decode_block=32, inflight=3)
+                                    decode_block=64, inflight=3)
         # Warm a full admission burst so the batched-prefill N=8 bucket
         # and the fused decode block both compile outside the timer.
         for i in range(slots):
             batcher.submit(Request(f"warm{i}", list(rng.integers(
-                0, config.vocab_size, 8)), max_new_tokens=48))
+                0, config.vocab_size, 8)), max_new_tokens=80))
         batcher.run_until_drained(max_steps=200)
         emitted["n"] = 0
         start = time.perf_counter()

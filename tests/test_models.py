@@ -408,3 +408,36 @@ def test_decode_block_interleaves_with_admission(tiny):
     assert steps < 500
     assert len(out["first"]) == 12
     assert len(out["late"]) == 6
+
+
+def test_remat_training_matches_and_microbatching_averages(tiny):
+    """LlamaConfig(remat=True) must not change the loss (it only
+    re-computes activations in the backward pass), and gradient
+    accumulation over microbatches must produce the same first-step
+    loss as the full batch (same tokens, averaged grads)."""
+    import dataclasses
+
+    from aiko_services_tpu.models.train import (init_train_state,
+                                                make_train_step)
+
+    config, _ = tiny
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0,
+                                config.vocab_size)
+
+    def first_loss(cfg, accumulate):
+        plan = MeshPlan.build({"dp": 2, "fsdp": 2, "tp": 2})
+        params, opt_state, optimizer = init_train_state(
+            jax.random.PRNGKey(0), cfg, plan)
+        step = make_train_step(cfg, plan, optimizer=optimizer,
+                               accumulate_steps=accumulate)
+        params, opt_state, loss = step(params, opt_state, tokens)
+        _, _, loss2 = step(params, opt_state, tokens)
+        assert float(loss2) < float(loss)       # still learns
+        return float(loss)
+
+    plain = first_loss(config, 1)
+    remat = first_loss(dataclasses.replace(config, remat=True), 1)
+    accumulated = first_loss(config, 2)
+    assert abs(plain - remat) < 1e-2            # identical computation
+    # Microbatch average equals batch mean CE up to bf16 noise.
+    assert abs(plain - accumulated) < 5e-2
