@@ -16,6 +16,8 @@ from .audio import (AudioReadFile, AudioWriteFile, AudioFraming,
 from .audio_live import (MicrophoneRead, SpeakerWrite, DataSchemeMic,
                          DataSchemeSpeaker)
 from .scheme_rtsp import DataSchemeRTSP, VideoReadRTSP, VideoWriteRTSP
+from .scheme_tensor import (DataSchemeTensorPipe, TensorReadPipe,
+                            TensorWritePipe)
 from .detect import Detector
 from .vision import FaceDetect, ArucoMarkerDetect
 from .llm import LLM, LLMService, PROTOCOL_LLM

@@ -1,0 +1,245 @@
+"""Native bulk-tensor transport (native/tensor_pipe.cpp via ctypes).
+
+The host<->host data plane for frames with no ICI path (SURVEY.md
+§5.8): the reference fills this role with libzmq, an external C++
+dependency (reference elements/media/scheme_zmq.py:12); here it is the
+framework's own single-file C++ library -- length-prefixed TCP frames
+carrying typed, shaped arrays -- compiled on demand like the native
+MQTT broker and bound through ctypes (no pybind11 in this image).
+
+Arrays cross as raw bytes plus a JSON header (dtype/shape/name), so a
+[1080, 1920, 3] uint8 video frame costs its 6.2 MB payload and ~60
+header bytes -- no base64, no pickling.  bfloat16 round-trips via
+ml_dtypes (jax's numpy extension types).
+
+::
+
+    server = TensorPipeServer()                  # kernel-assigned port
+    client = TensorPipeClient("127.0.0.1", server.port)
+    client.send(array, name="frame0")
+    name, again = server.recv(timeout=1.0)
+
+Concurrency model: the server accepts on a background thread and fans
+every connection's frames into one bounded queue (drop-oldest, like
+the live-capture backends); sends are synchronous on the caller.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import pathlib
+import queue
+import shutil
+import subprocess
+import threading
+
+import numpy as np
+
+from ..utils import get_logger
+
+__all__ = ["TensorPipeServer", "TensorPipeClient", "encode_header",
+           "decode_header"]
+
+_logger = get_logger("aiko.tensor_pipe")
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+_LIBRARY = None
+_LIBRARY_LOCK = threading.Lock()
+
+
+def _build_library() -> pathlib.Path:
+    source = _REPO_ROOT / "native" / "tensor_pipe.cpp"
+    build_dir = _REPO_ROOT / "native" / "build"
+    build_dir.mkdir(exist_ok=True)
+    shared = build_dir / "libtensor_pipe.so"
+    if shared.exists() \
+            and shared.stat().st_mtime >= source.stat().st_mtime:
+        return shared
+    compiler = shutil.which("g++") or shutil.which("c++")
+    if compiler is None:
+        raise RuntimeError("no C++ compiler to build tensor_pipe")
+    _logger.info("building %s", shared)
+    subprocess.run(
+        [compiler, "-O2", "-std=c++17", "-shared", "-fPIC",
+         "-o", str(shared), str(source)],
+        check=True, capture_output=True, text=True)
+    return shared
+
+
+def _library() -> ctypes.CDLL:
+    global _LIBRARY
+    with _LIBRARY_LOCK:
+        if _LIBRARY is None:
+            lib = ctypes.CDLL(str(_build_library()))
+            lib.tp_listen.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.tp_listen.restype = ctypes.c_int
+            lib.tp_port.argtypes = [ctypes.c_int]
+            lib.tp_port.restype = ctypes.c_int
+            lib.tp_accept.argtypes = [ctypes.c_int, ctypes.c_int]
+            lib.tp_accept.restype = ctypes.c_int
+            lib.tp_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int]
+            lib.tp_connect.restype = ctypes.c_int
+            lib.tp_send.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_void_p, ctypes.c_uint64]
+            lib.tp_send.restype = ctypes.c_int
+            lib.tp_recv_begin.argtypes = [
+                ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.tp_recv_begin.restype = ctypes.c_int
+            lib.tp_recv_body.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+            lib.tp_recv_body.restype = ctypes.c_int
+            lib.tp_close.argtypes = [ctypes.c_int]
+            lib.tp_close.restype = None
+            _LIBRARY = lib
+    return _LIBRARY
+
+
+def encode_header(array: np.ndarray, name: str) -> bytes:
+    return json.dumps({"dtype": str(array.dtype),
+                       "shape": list(array.shape),
+                       "name": name}).encode()
+
+
+def decode_header(header: bytes) -> tuple:
+    meta = json.loads(header.decode())
+    return meta.get("name", ""), np.dtype(meta["dtype"]), \
+        tuple(meta["shape"])
+
+
+class TensorPipeClient:
+    """Synchronous sender: one TCP connection, framed array sends."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self._lib = _library()
+        self._fd = self._lib.tp_connect(host.encode(), int(port),
+                                        int(timeout * 1000))
+        if self._fd < 0:
+            raise ConnectionError(f"tensor_pipe connect "
+                                  f"{host}:{port} failed")
+        self._lock = threading.Lock()
+
+    def send(self, array, name: str = ""):
+        data = np.ascontiguousarray(np.asarray(array))
+        header = encode_header(data, name)
+        payload = data.ctypes.data_as(ctypes.c_void_p) if data.size \
+            else None
+        with self._lock:
+            if self._lib.tp_send(self._fd, header, len(header),
+                                 payload, data.nbytes) != 0:
+                raise ConnectionError("tensor_pipe send failed "
+                                      "(peer gone?)")
+
+    def close(self):
+        self._lib.tp_close(self._fd)
+        self._fd = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_):
+        self.close()
+
+
+class TensorPipeServer:
+    """Receiver: accepts connections on a background thread, fans all
+    frames into one bounded queue (oldest dropped under backlog -- the
+    live-capture policy: a slow consumer loses frames, never stalls
+    producers)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 queue_depth: int = 64):
+        self._lib = _library()
+        self._server_fd = self._lib.tp_listen(host.encode(), int(port))
+        if self._server_fd < 0:
+            raise OSError(f"tensor_pipe listen {host}:{port} failed")
+        self.port = self._lib.tp_port(self._server_fd)
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_depth)
+        self._closing = threading.Event()
+        self._readers: list = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="aiko.tensor_pipe.accept")
+        self._accept_thread.start()
+
+    # -- background machinery ---------------------------------------------
+
+    def _accept_loop(self):
+        while not self._closing.is_set():
+            fd = self._lib.tp_accept(self._server_fd, 200)
+            if fd < 0:
+                continue
+            reader = threading.Thread(target=self._read_loop,
+                                      args=(fd,), daemon=True,
+                                      name="aiko.tensor_pipe.read")
+            self._readers.append((fd, reader))
+            reader.start()
+
+    def _read_loop(self, fd: int):
+        header_len = ctypes.c_uint32()
+        payload_len = ctypes.c_uint64()
+        while not self._closing.is_set():
+            rc = self._lib.tp_recv_begin(fd, 200,
+                                         ctypes.byref(header_len),
+                                         ctypes.byref(payload_len))
+            if rc == -1:
+                continue           # clean timeout: keep polling
+            if rc != 0:
+                break              # closed / torn / corrupt: drop conn
+            header = ctypes.create_string_buffer(header_len.value)
+            payload = (ctypes.c_char * payload_len.value)()
+            if self._lib.tp_recv_body(
+                    fd, header, header_len.value,
+                    ctypes.cast(payload, ctypes.c_void_p),
+                    payload_len.value, 5000) != 0:
+                break                              # torn frame: drop conn
+            try:
+                name, dtype, shape = decode_header(header.raw)
+                # Zero-copy view: the ctypes buffer is a fresh
+                # per-frame allocation nothing else retains.
+                array = np.frombuffer(payload, dtype=dtype) \
+                    .reshape(shape)
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue                           # corrupt header
+            try:
+                self._queue.put_nowait((name, array))
+            except queue.Full:
+                try:                               # drop-oldest
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    self._queue.put_nowait((name, array))
+                except queue.Full:
+                    pass
+        self._lib.tp_close(fd)
+        self._readers[:] = [(f, t) for f, t in self._readers
+                            if f != fd]
+
+    # -- API ---------------------------------------------------------------
+
+    def recv(self, timeout: float | None = None):
+        """(name, array) or None on timeout."""
+        try:
+            return self._queue.get(timeout=timeout) if timeout \
+                else self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def close(self):
+        self._closing.set()
+        self._lib.tp_close(self._server_fd)
+        self._accept_thread.join(timeout=2.0)
+        for _, reader in self._readers:
+            reader.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_):
+        self.close()

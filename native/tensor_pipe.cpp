@@ -1,0 +1,207 @@
+// tensor_pipe: length-prefixed TCP tensor transport (C ABI, used via
+// ctypes from aiko_services_tpu/transport/tensor_pipe.py).
+//
+// The framework's native bulk data plane for host<->host hops with no
+// ICI path (SURVEY.md section 5.8): the reference delegates this role
+// to libzmq (an external C++ dependency, reference
+// elements/media/scheme_zmq.py:12); here it is part of the framework,
+// a single-file library beside the native MQTT broker.
+//
+// Frame wire format (little-endian):
+//   u32 magic 'TPIP' | u32 header_len | u64 payload_len
+//   header bytes (JSON: dtype/shape/name) | payload bytes
+//
+// Design: blocking socket calls bounded by poll() timeouts; one OS fd
+// per handle, no internal threads or buffers -- concurrency and
+// framing policy live in Python, where the event model already is.
+// Handles are plain fds, so the library is state-free and fork-safe.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -o libtensor_pipe.so
+//        tensor_pipe.cpp
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x54504950;  // "TPIP"
+
+int wait_readable(int fd, int timeout_ms) {
+    pollfd p{fd, POLLIN, 0};
+    int rc = ::poll(&p, 1, timeout_ms);
+    if (rc <= 0) return -1;                       // timeout or error
+    return 0;
+}
+
+// Read exactly n bytes.  Returns 0 on success, -1 on a CLEAN timeout
+// (no byte consumed -- safe to retry later), -2 on close/error or a
+// mid-read timeout (bytes already consumed: the stream is torn and
+// the caller must drop the connection, retrying would desync).
+int read_exact(int fd, void* buffer, uint64_t n, int timeout_ms) {
+    auto* out = static_cast<uint8_t*>(buffer);
+    uint64_t done = 0;
+    while (done < n) {
+        if (wait_readable(fd, timeout_ms) != 0)
+            return done == 0 ? -1 : -2;
+        ssize_t got = ::recv(fd, out + done, n - done, 0);
+        if (got == 0) return -2;                  // peer closed (EOF)
+        if (got < 0) {
+            if (errno == EINTR) continue;
+            return -2;
+        }
+        done += static_cast<uint64_t>(got);
+    }
+    return 0;
+}
+
+int write_exact(int fd, const void* buffer, uint64_t n) {
+    auto* in = static_cast<const uint8_t*>(buffer);
+    uint64_t done = 0;
+    while (done < n) {
+        ssize_t put = ::send(fd, in + done, n - done, MSG_NOSIGNAL);
+        if (put < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        done += static_cast<uint64_t>(put);
+    }
+    return 0;
+}
+
+void tune(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Listening socket on host:port (port 0 = kernel-assigned); returns fd
+// or -1.
+int tp_listen(const char* host, int port) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in address{};
+    address.sin_family = AF_INET;
+    address.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &address.sin_addr) != 1) {
+        ::close(fd);
+        return -1;
+    }
+    if (::bind(fd, reinterpret_cast<sockaddr*>(&address),
+               sizeof(address)) != 0
+        || ::listen(fd, 16) != 0) {
+        ::close(fd);
+        return -1;
+    }
+    return fd;
+}
+
+// The actual bound port of a listening fd (for port 0 requests).
+int tp_port(int fd) {
+    sockaddr_in address{};
+    socklen_t len = sizeof(address);
+    if (::getsockname(fd, reinterpret_cast<sockaddr*>(&address),
+                      &len) != 0)
+        return -1;
+    return ntohs(address.sin_port);
+}
+
+// Accept one connection (-1 on timeout/error).
+int tp_accept(int server_fd, int timeout_ms) {
+    if (wait_readable(server_fd, timeout_ms) != 0) return -1;
+    int fd = ::accept(server_fd, nullptr, nullptr);
+    if (fd >= 0) tune(fd);
+    return fd;
+}
+
+int tp_connect(const char* host, int port, int timeout_ms) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in address{};
+    address.sin_family = AF_INET;
+    address.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &address.sin_addr) != 1) {
+        ::close(fd);
+        return -1;
+    }
+    // Bounded connect: non-blocking + poll, then back to blocking.
+    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&address),
+                  sizeof(address)) != 0) {
+        ::close(fd);
+        return -1;
+    }
+    tune(fd);
+    return fd;
+}
+
+// One framed message: header + payload in a single call.
+int tp_send(int fd, const void* header, uint32_t header_len,
+            const void* payload, uint64_t payload_len) {
+    uint8_t prefix[16];
+    uint32_t magic = kMagic;
+    std::memcpy(prefix, &magic, 4);
+    std::memcpy(prefix + 4, &header_len, 4);
+    std::memcpy(prefix + 8, &payload_len, 8);
+    if (write_exact(fd, prefix, sizeof(prefix)) != 0) return -1;
+    if (header_len && write_exact(fd, header, header_len) != 0)
+        return -1;
+    if (payload_len && write_exact(fd, payload, payload_len) != 0)
+        return -1;
+    return 0;
+}
+
+// Frame sanity caps: a desynced or hostile peer must not drive
+// allocations from 8 arbitrary wire bytes.
+constexpr uint32_t kMaxHeader = 1u << 20;         // 1 MiB of JSON
+constexpr uint64_t kMaxPayload = 1ull << 32;      // 4 GiB per tensor
+
+// Phase 1: read the frame prefix -> header/payload lengths (so the
+// caller can allocate).  Returns 0 ok, -1 clean timeout (retry),
+// -2 closed/torn (drop the connection), -3 corrupt (bad magic or an
+// absurd length -- drop the connection).
+int tp_recv_begin(int fd, int timeout_ms, uint32_t* header_len,
+                  uint64_t* payload_len) {
+    uint8_t prefix[16];
+    int rc = read_exact(fd, prefix, sizeof(prefix), timeout_ms);
+    if (rc != 0) return rc;
+    uint32_t magic;
+    std::memcpy(&magic, prefix, 4);
+    if (magic != kMagic) return -3;               // stream corrupt
+    std::memcpy(header_len, prefix + 4, 4);
+    std::memcpy(payload_len, prefix + 8, 8);
+    if (*header_len > kMaxHeader || *payload_len > kMaxPayload)
+        return -3;
+    return 0;
+}
+
+// Phase 2: read the announced bytes into caller buffers.  Any failure
+// here means a torn frame: returns -2 (drop the connection).
+int tp_recv_body(int fd, void* header, uint32_t header_len,
+                 void* payload, uint64_t payload_len, int timeout_ms) {
+    if (header_len
+        && read_exact(fd, header, header_len, timeout_ms) != 0)
+        return -2;
+    if (payload_len
+        && read_exact(fd, payload, payload_len, timeout_ms) != 0)
+        return -2;
+    return 0;
+}
+
+void tp_close(int fd) {
+    if (fd >= 0) ::close(fd);
+}
+
+}  // extern "C"
