@@ -26,7 +26,7 @@ def _host_port(url: str) -> tuple:
     """``tensor://host:port`` -> (host, port); raises ValueError with a
     usable message on a missing/malformed port (callers surface it as
     a StreamEvent.ERROR diagnostic)."""
-    location = url.split("://", 1)[1]
+    location = DataScheme.parse_data_url_path(url)
     host, separator, port = location.rpartition(":")
     if not separator or not port.isdigit():
         raise ValueError(f"{url!r}: expected tensor://host:port")

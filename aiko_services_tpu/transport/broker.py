@@ -21,7 +21,8 @@ import subprocess
 
 from ..utils import get_logger
 
-__all__ = ["broker_binary", "BrokerProcess", "native_dir"]
+__all__ = ["broker_binary", "build_native", "BrokerProcess",
+           "native_dir"]
 
 _logger = get_logger("aiko.broker")
 
@@ -32,24 +33,35 @@ def native_dir() -> pathlib.Path:
     return _REPO_ROOT / "native"
 
 
-def broker_binary(rebuild: bool = False) -> pathlib.Path:
-    """Compile native/mqtt_broker.cpp (cached by mtime) and return the
-    binary path."""
-    source = native_dir() / "mqtt_broker.cpp"
+def build_native(source_name: str, output_name: str,
+                 extra_flags: tuple = (), rebuild: bool = False) \
+        -> pathlib.Path:
+    """Compile a native/ source (cached by mtime) -> build artifact
+    path.  Shared by the broker binary and the tensor_pipe shared
+    library so the build recipe lives in exactly one place."""
+    source = native_dir() / source_name
     build_dir = native_dir() / "build"
     build_dir.mkdir(exist_ok=True)
-    binary = build_dir / "mqtt_broker"
-    if (not rebuild and binary.exists()
-            and binary.stat().st_mtime >= source.stat().st_mtime):
-        return binary
+    artifact = build_dir / output_name
+    if (not rebuild and artifact.exists()
+            and artifact.stat().st_mtime >= source.stat().st_mtime):
+        return artifact
     compiler = shutil.which("g++") or shutil.which("c++")
     if compiler is None:
-        raise RuntimeError("no C++ compiler found to build the broker")
-    _logger.info("building %s", binary)
+        raise RuntimeError(f"no C++ compiler found to build "
+                           f"{source_name}")
+    _logger.info("building %s", artifact)
     subprocess.run(
-        [compiler, "-O2", "-std=c++17", "-o", str(binary), str(source)],
+        [compiler, "-O2", "-std=c++17", *extra_flags,
+         "-o", str(artifact), str(source)],
         check=True, capture_output=True, text=True)
-    return binary
+    return artifact
+
+
+def broker_binary(rebuild: bool = False) -> pathlib.Path:
+    """Compile native/mqtt_broker.cpp and return the binary path."""
+    return build_native("mqtt_broker.cpp", "mqtt_broker",
+                        rebuild=rebuild)
 
 
 class BrokerProcess:

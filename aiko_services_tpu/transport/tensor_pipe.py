@@ -28,43 +28,23 @@ from __future__ import annotations
 
 import ctypes
 import json
-import pathlib
 import queue
-import shutil
-import subprocess
 import threading
 
 import numpy as np
 
-from ..utils import get_logger
+from .broker import build_native
 
 __all__ = ["TensorPipeServer", "TensorPipeClient", "encode_header",
            "decode_header"]
 
-_logger = get_logger("aiko.tensor_pipe")
-
-_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
 _LIBRARY = None
 _LIBRARY_LOCK = threading.Lock()
 
 
-def _build_library() -> pathlib.Path:
-    source = _REPO_ROOT / "native" / "tensor_pipe.cpp"
-    build_dir = _REPO_ROOT / "native" / "build"
-    build_dir.mkdir(exist_ok=True)
-    shared = build_dir / "libtensor_pipe.so"
-    if shared.exists() \
-            and shared.stat().st_mtime >= source.stat().st_mtime:
-        return shared
-    compiler = shutil.which("g++") or shutil.which("c++")
-    if compiler is None:
-        raise RuntimeError("no C++ compiler to build tensor_pipe")
-    _logger.info("building %s", shared)
-    subprocess.run(
-        [compiler, "-O2", "-std=c++17", "-shared", "-fPIC",
-         "-o", str(shared), str(source)],
-        check=True, capture_output=True, text=True)
-    return shared
+def _build_library():
+    return build_native("tensor_pipe.cpp", "libtensor_pipe.so",
+                        extra_flags=("-shared", "-fPIC"))
 
 
 def _library() -> ctypes.CDLL:
@@ -204,8 +184,12 @@ class TensorPipeServer:
                 # per-frame allocation nothing else retains.
                 array = np.frombuffer(payload, dtype=dtype) \
                     .reshape(shape)
-            except (ValueError, KeyError, json.JSONDecodeError):
-                continue                           # corrupt header
+            except Exception:
+                # Corrupt/hostile header (np.dtype raises TypeError,
+                # a non-dict body AttributeError, ...): skip the frame
+                # -- never let it kill the reader thread, which would
+                # leak the fd and silently deaden the connection.
+                continue
             try:
                 self._queue.put_nowait((name, array))
             except queue.Full:

@@ -68,6 +68,13 @@ int write_exact(int fd, const void* buffer, uint64_t n) {
         ssize_t put = ::send(fd, in + done, n - done, MSG_NOSIGNAL);
         if (put < 0) {
             if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                // Kernel buffer full (slow receiver): wait for space
+                // rather than tearing the stream mid-frame.
+                pollfd p{fd, POLLOUT, 0};
+                if (::poll(&p, 1, -1) <= 0) return -1;
+                continue;
+            }
             return -1;
         }
         done += static_cast<uint64_t>(put);
@@ -135,7 +142,9 @@ int tp_connect(const char* host, int port, int timeout_ms) {
         ::close(fd);
         return -1;
     }
-    // Bounded connect: non-blocking + poll, then back to blocking.
+    // Bounded connect via a temporary send timeout -- CLEARED after
+    // the handshake, or a later large send stalling past it would
+    // spuriously fail (EAGAIN) and tear a healthy connection.
     timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
     ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
     if (::connect(fd, reinterpret_cast<sockaddr*>(&address),
@@ -143,6 +152,9 @@ int tp_connect(const char* host, int port, int timeout_ms) {
         ::close(fd);
         return -1;
     }
+    timeval forever{0, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &forever,
+                 sizeof(forever));
     tune(fd);
     return fd;
 }

@@ -49,20 +49,22 @@ def test_multiple_senders_fan_in():
 
 
 def test_backlog_drops_oldest():
+    """Under backlog the NEWEST frames survive (drop-oldest policy).
+    Whether any drop happens at all depends on reader scheduling, so
+    the assertions are order/newest-kept, not an exact count."""
     with TensorPipeServer(queue_depth=4) as server:
         with TensorPipeClient("127.0.0.1", server.port) as client:
             for i in range(12):
                 client.send(np.asarray([i], np.int32))
-            # Drain whatever survived: must be the NEWEST frames.
             survivors = []
             while True:
                 frame = server.recv(timeout=1.0)
                 if frame is None:
                     break
                 survivors.append(int(frame[1][0]))
-            assert survivors            # something arrived
-            assert len(survivors) <= 8  # bounded by depth (+ in flight)
-            assert survivors[-1] == 11  # newest kept
+            assert survivors                       # something arrived
+            assert survivors[-1] == 11             # newest kept
+            assert survivors == sorted(survivors)  # order preserved
 
 
 def test_send_to_closed_server_raises():
