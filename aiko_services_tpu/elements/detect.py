@@ -11,6 +11,9 @@ overlay dict the reference's elements feed ImageOverlay
 
 from __future__ import annotations
 
+import queue
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,18 +32,34 @@ class Detector(TPUElement):
 
     Parameters: ``num_classes``, ``class_names``, ``score_threshold``,
     ``checkpoint`` (optional orbax directory with {"params": ...}).
+
+    ASYNC by default: the jitted detect is dispatched from the event
+    loop (JAX dispatch is asynchronous), the frame parks, and only the
+    host fetch of boxes/scores blocks -- on a single fetch thread, not
+    the event loop.  Frame k+1's detect is therefore already on the
+    device queue while frame k's results copy back, and downstream
+    stages (LLM decode) overlap detect on the device.  Set parameter
+    ``synchronous: true`` for the blocking path.
     """
+
+    is_async = True
 
     def __init__(self, context):
         super().__init__(context)
         self._params = None
         self._config = None
         self._detect = None
+        # Single DAEMON fetch worker (not a ThreadPoolExecutor: its
+        # non-daemon workers would outlive every stream and join at
+        # interpreter exit).  One thread per element for the element's
+        # lifetime; FIFO keeps frame completion ordered.
+        self._fetch_queue: queue.Queue | None = None
 
     def on_replacement(self):
         super().on_replacement()
         self._params = None             # _ensure_model reloads on the
         self._detect = None             # replacement submesh
+        self._stop_fetcher()            # old thread referenced old params
 
     def _ensure_model(self):
         if self._params is not None:
@@ -79,14 +98,63 @@ class Detector(TPUElement):
             lambda params, images:
             detector.detect.__wrapped__(params, config, images))
 
-    def process_frame(self, stream, image=None, **inputs):
-        self._ensure_model()
+    def _dispatch(self, image):
+        """Enqueue the jitted detect (asynchronous on the device)."""
         array = jnp.asarray(image)
         if array.dtype == jnp.uint8:
             array = array.astype(jnp.float32) / 255.0
         batched = array[None] if array.ndim == 3 else array
-        result = self._detect(self._params, batched)
+        return self._detect(self._params, batched)
 
+    def process_frame_start(self, stream, complete, image=None, **inputs):
+        self._ensure_model()
+        if self._fetch_queue is None:
+            self._fetch_queue = queue.Queue()
+            threading.Thread(target=self._fetch_loop,
+                             args=(self._fetch_queue,), daemon=True,
+                             name=f"detect-fetch-{self.name}").start()
+        result = self._dispatch(image)
+        for leaf in jax.tree_util.tree_leaves(result):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        # Only the fetch blocks, and it blocks the fetch thread: the
+        # event loop is already free to dispatch the next frame's detect.
+        self._fetch_queue.put((complete, image, result))
+
+    def _fetch_loop(self, fetch_queue):
+        while True:
+            item = fetch_queue.get()
+            if item is None:          # drain-then-exit sentinel
+                return
+            self._finish_frame(*item)
+
+    def _stop_fetcher(self):
+        """Retire the fetch thread (in-flight frames drain first); a
+        later async frame lazily starts a fresh one.  Without this the
+        thread would pin the element -- and its device weights --
+        forever."""
+        fetch_queue, self._fetch_queue = self._fetch_queue, None
+        if fetch_queue is not None:
+            fetch_queue.put(None)
+
+    def stop_stream(self, stream, stream_id):
+        self._stop_fetcher()
+        return super().stop_stream(stream, stream_id)
+
+    def _finish_frame(self, complete, image, result):
+        try:
+            outputs = self._postprocess(image, result)
+        except Exception as error:            # pragma: no cover - defensive
+            complete(StreamEvent.ERROR, {"diagnostic": str(error)})
+            return
+        complete(StreamEvent.OKAY, outputs)
+
+    def process_frame(self, stream, image=None, **inputs):
+        self._ensure_model()
+        result = self._dispatch(image)
+        return StreamEvent.OKAY, self._postprocess(image, result)
+
+    def _postprocess(self, image, result) -> dict:
         boxes = np.asarray(result["boxes"][0], dtype=np.float32)
         scores = np.asarray(result["scores"][0], dtype=np.float32)
         classes = np.asarray(result["classes"][0])
@@ -109,7 +177,6 @@ class Detector(TPUElement):
             detections.append({"class": name,
                                "score": float(scores[i]),
                                "box": [x1, y1, x2, y2]})
-        return StreamEvent.OKAY, {
-            "image": image,
-            "overlay": {"rectangles": rectangles},
-            "detections": detections}
+        return {"image": image,
+                "overlay": {"rectangles": rectangles},
+                "detections": detections}

@@ -41,7 +41,7 @@ from ..models.checkpoint import maybe_restore as _restore
 from ..models.tokenizer import ByteTokenizer, load_tokenizer
 from ..pipeline import PipelineElement, StreamEvent
 from ..services import Actor
-from ..utils import generate, get_logger, parse_number
+from ..utils import generate, get_logger, parse_bool, parse_number
 
 __all__ = ["LLMService", "LLM", "DetectionCaption", "PROTOCOL_LLM"]
 
@@ -66,7 +66,8 @@ class LLMService(Actor):
     def __init__(self, name: str = "llm", runtime=None,
                  config: llama.LlamaConfig | None = None,
                  params=None, tokenizer=None, max_slots: int = 8,
-                 checkpoint: str | None = None, seed: int = 0):
+                 checkpoint: str | None = None, seed: int = 0,
+                 decode_block: int = 1, inflight: int = 2):
         super().__init__(name, PROTOCOL_LLM, tags=["ec=true"],
                          runtime=runtime)
         if config is None:
@@ -76,8 +77,14 @@ class LLMService(Actor):
                 llama.init_params(jax.random.PRNGKey(seed), config),
                 checkpoint)
         self.tokenizer = tokenizer or ByteTokenizer()
+        # decode_block > 1 with inflight > 1 is the pipelined serving
+        # path (fused multi-step blocks chained device-side) -- the same
+        # configuration the bench runs; the wire-facing server defaults
+        # stay at one-step dispatches so token streaming is per-step.
         self.batcher = ContinuousBatcher(params, config,
-                                         max_slots=max_slots)
+                                         max_slots=max_slots,
+                                         decode_block=decode_block,
+                                         inflight=inflight)
         # Keyed by (response_topic, request_id): two callers independently
         # choosing the same request_id (both starting at "1") must not
         # collide -- the response topic is the caller's identity.
@@ -109,7 +116,7 @@ class LLMService(Actor):
     def _start_pump(self):
         if not self._pumping:
             self._pumping = True
-            self.runtime.engine.post(self._pump)
+            self.runtime.engine.post_deferred(self._pump)
 
     def _pump(self):
         active = self.batcher.step()
@@ -119,7 +126,9 @@ class LLMService(Actor):
                                 self.batcher.tokens_emitted)
         if active or self.batcher.queue_depth \
                 or self.batcher.blocks_in_flight:
-            self.runtime.engine.post(self._pump)    # interleave, not block
+            # Deferred, not synchronous: new (generate ...) messages
+            # interleave between decode ticks and join the batch.
+            self.runtime.engine.post_deferred(self._pump)
         else:
             self._pumping = False
 
@@ -196,16 +205,26 @@ class LLM(PipelineElement):
     (keep N fused blocks in flight, chained device-side: hides the
     dispatch round trip behind device compute).
 
-    Generation runs inline on the event loop (the reference's LLM
-    element equally blocks on its Ollama HTTP call); deploy this element
-    in its own pipeline behind a remote stage when other traffic must
-    not wait.
+    ASYNC by default: each frame submits its request to the shared
+    :class:`ContinuousBatcher` and parks; the batcher pump rides the
+    event engine, so decode ticks interleave with message handling and
+    with OTHER frames' stages -- requests from many in-flight
+    frames/streams decode together in one device batch (continuous
+    batching across frames, not per-frame drains).  Set parameter
+    ``synchronous: true`` for the blocking per-frame path.
     """
+
+    is_async = True
 
     def __init__(self, context):
         super().__init__(context)
         self._batcher: ContinuousBatcher | None = None
         self._tokenizer = None
+        self._pumping = False
+        self._request_seq = 0
+        # request_id -> complete for parked async frames, so a failing
+        # pump can error them out instead of leaving them parked.
+        self._completes: dict = {}
 
     def _ensure_model(self):
         if self._batcher is not None:
@@ -243,7 +262,7 @@ class LLM(PipelineElement):
             checkpoint)
         quantize, _ = self.get_parameter("quantize", False)
         normalized = str(quantize).strip().lower()
-        if normalized in ("true", "1", "yes", "on", "int8"):
+        if parse_bool(quantize) or normalized == "int8":
             # Weight-only int8 (models/quant.py): halves decode's HBM
             # stream; activations/cache stay bf16.
             from ..models.quant import quantize_params
@@ -259,19 +278,74 @@ class LLM(PipelineElement):
             params, config, decode_block=int(decode_block),
             inflight=int(inflight))
 
-    def process_frame(self, stream, text=None, **inputs):
-        self._ensure_model()
+    def _make_request(self, stream, text) -> tuple[Request, list[int]]:
         max_new, _ = self.get_parameter("max_new_tokens", 32)
         temperature, _ = self.get_parameter("temperature", 0.0)
         system_prompt, _ = self.get_parameter("system_prompt", "")
         prompt = f"{system_prompt}{text}" if system_prompt else str(text)
-
+        self._request_seq += 1
         collected: list[int] = []
-        self._batcher.submit(Request(
-            request_id=f"frame_{stream.stream_id}",
+        return Request(
+            request_id=f"{stream.stream_id}/{self._request_seq}",
             prompt_tokens=self._tokenizer.encode(prompt),
             max_new_tokens=int(max_new), temperature=float(temperature),
             eos_tokens=self._tokenizer.eos_tokens,
-            emit=_collector(self._tokenizer, collected)))
+            emit=_collector(self._tokenizer, collected)), collected
+
+    def process_frame_start(self, stream, complete, text=None, **inputs):
+        self._ensure_model()
+        request, collected = self._make_request(stream, text)
+        tokenizer, inner_emit = self._tokenizer, request.emit
+
+        def emit(request_id, token, finished):
+            inner_emit(request_id, token, finished)
+            if finished:
+                self._completes.pop(request_id, None)
+                complete(StreamEvent.OKAY,
+                         {"text": tokenizer.decode(collected)})
+
+        request.emit = emit
+        self._completes[request.request_id] = complete
+        self._batcher.submit(request)
+        self._start_pump()
+
+    def _start_pump(self):
+        if not self._pumping:
+            self._pumping = True
+            self.pipeline.runtime.engine.post_deferred(self._pump)
+
+    def _pump(self):
+        batcher = self._batcher
+        if batcher is None:             # stopped mid-flight
+            self._pumping = False
+            return
+        try:
+            batcher.step()
+        except Exception as error:
+            # A decode tick failing (device error, bad checkpoint
+            # shapes) must FAIL the parked frames, not silently stop
+            # the pump with them parked forever -- the async analogue
+            # of the engine's per-element try/except.
+            self.logger.exception("LLM pump step failed")
+            self._pumping = False
+            completes, self._completes = self._completes, {}
+            for complete in completes.values():
+                complete(StreamEvent.ERROR,
+                         {"diagnostic": f"llm decode failed: {error}"})
+            return
+        if (batcher.active_count or batcher.queue_depth
+                or batcher.blocks_in_flight):
+            # Deferred so in-flight frames' submits land between decode
+            # ticks and batch together.
+            self.pipeline.runtime.engine.post_deferred(self._pump)
+        else:
+            self._pumping = False
+
+    def process_frame(self, stream, text=None, **inputs):
+        """Blocking path (``synchronous: true`` or direct invocation):
+        drains the batcher inline."""
+        self._ensure_model()
+        request, collected = self._make_request(stream, text)
+        self._batcher.submit(request)
         self._batcher.run_until_drained()
         return StreamEvent.OKAY, {"text": self._tokenizer.decode(collected)}

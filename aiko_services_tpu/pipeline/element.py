@@ -24,7 +24,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from .stream import Stream, StreamEvent
-from ..utils import get_logger
+from ..utils import get_logger, parse_bool
 
 __all__ = ["PipelineElement", "PipelineElementLoop", "ElementContext"]
 
@@ -44,6 +44,12 @@ class ElementContext:
 
 
 class PipelineElement:
+    #: Async-capable elements set this True and implement
+    #: ``process_frame_start``; the engine then parks the frame at this
+    #: stage and resumes it on completion, so multiple frames are in
+    #: flight across stages (detect(k+1) overlaps decode(k)).
+    is_async = False
+
     def __init__(self, context: ElementContext):
         self.context = context
         self.name = context.name
@@ -60,6 +66,28 @@ class PipelineElement:
     def process_frame(self, stream: Stream, **inputs) \
             -> tuple[StreamEvent, dict]:
         raise NotImplementedError
+
+    def process_frame_start(self, stream: Stream, complete: Callable,
+                            **inputs) -> None:
+        """Non-blocking contract for ``is_async`` elements: submit the
+        frame's work and return immediately; call
+        ``complete(event, outputs)`` exactly once when it finishes (from
+        any thread -- the call hops through the pipeline's mailbox).
+        The engine parks the frame at this stage and resumes downstream
+        elements on completion -- the local analogue of the remote
+        park/forward/resume dance, so an accelerator-backed stage never
+        serializes the event loop and frames overlap stages."""
+        raise NotImplementedError
+
+    def frame_is_async(self, stream: Stream) -> bool:
+        """Whether this frame takes the parked/async path.  The
+        ``synchronous`` parameter (stream/element/pipeline resolution)
+        forces the blocking ``process_frame`` path on async-capable
+        elements."""
+        if not self.is_async:
+            return False
+        synchronous, found = self.get_parameter("synchronous", False)
+        return not (found and parse_bool(synchronous))
 
     def stop_stream(self, stream: Stream, stream_id):
         return StreamEvent.OKAY, {}

@@ -271,13 +271,19 @@ class Pipeline(Actor):
     # -- stream lifecycle --------------------------------------------------
 
     def create_stream(self, stream_id=None, *parameters):
-        """Wire command: ``(create_stream id (params...) grace_time)``."""
+        """Wire command: ``(create_stream id (params...) grace_time)``.
+        A ``graph_path`` entry in the params dict selects which named
+        graph path (head element) this stream runs (reference
+        pipeline.py:641 create_stream(graph_path=...); example:
+        examples/pipeline/pipeline_paths.json)."""
         params = dict(parameters[0]) if parameters and isinstance(
             parameters[0], dict) else {}
         grace_time = parse_number(parameters[1], _GRACE_TIME_DEFAULT) \
             if len(parameters) > 1 else _GRACE_TIME_DEFAULT
+        graph_path = params.pop("graph_path", None)
         self.create_stream_local(stream_id or DEFAULT_STREAM_ID,
-                                 parameters=params, grace_time=grace_time)
+                                 parameters=params, graph_path=graph_path,
+                                 grace_time=grace_time)
 
     def create_stream_local(self, stream_id, parameters=None,
                             graph_path=None, grace_time=_GRACE_TIME_DEFAULT,
@@ -287,6 +293,14 @@ class Pipeline(Actor):
         if stream_id in self.streams:
             self.logger.warning("stream %s already exists", stream_id)
             return self.streams[stream_id]
+        heads = [node.name for node in self.graph.heads]
+        if graph_path is not None and str(graph_path) not in heads:
+            # Heads only: starting mid-graph would skip the head
+            # element's outputs and run a partial path.
+            self.logger.error("stream %s: graph_path %r is not a graph "
+                              "head (heads: %s)", stream_id, graph_path,
+                              heads)
+            return None
         stream = Stream(stream_id=stream_id, graph_path=graph_path,
                         parameters=dict(parameters or {}),
                         queue_response=queue_response,
@@ -460,6 +474,9 @@ class Pipeline(Actor):
                 self.run_hook("pipeline.process_element:0",
                               lambda: {"element": node.name,
                                        "frame": frame.frame_id})
+                if element.frame_is_async(stream):
+                    self._submit_frame_async(stream, frame, node, inputs)
+                    return        # frame parked at local async stage
                 start = time.perf_counter()
                 if _METRICS_MEMORY:
                     rss_before = process_memory_rss()
@@ -520,6 +537,76 @@ class Pipeline(Actor):
             self._frame_done(stream, frame, nodes)
         finally:
             self._current_stream_ref = None
+
+    # -- local async stage park / submit / resume --------------------------
+
+    def _submit_frame_async(self, stream: Stream, frame: Frame, node,
+                            inputs: dict) -> None:
+        """Park the frame at a local async stage and hand it the inputs.
+        The element calls ``complete(event, outputs)`` exactly once
+        (from any thread); the frame resumes downstream via the actor
+        mailbox -- the in-process twin of ``_forward_frame`` for remote
+        stages, realizing dataflow over an async accelerator: detect of
+        frame k+1 runs while the LLM decodes frame k, and a batching
+        element sees requests from many frames/streams at once."""
+        frame.paused_pe_name = node.name
+        stream_id, frame_id = stream.stream_id, frame.frame_id
+        node_name = node.name
+        start = time.perf_counter()
+        state = {"done": False}
+
+        def complete(event, outputs=None):
+            if state["done"]:
+                return                  # double completion: ignore
+            state["done"] = True
+            self.post_self("resume_frame_local",
+                           [stream_id, frame_id, node_name, event,
+                            outputs or {},
+                            time.perf_counter() - start])
+
+        try:
+            node.element.process_frame_start(stream, complete, **inputs)
+        except Exception as error:
+            self.logger.exception("element %s submit raised", node_name)
+            state["done"] = True        # a late complete() must not win
+            frame.paused_pe_name = None
+            self._frame_error(stream, frame, f"{node_name}: {error}")
+
+    def resume_frame_local(self, stream_id, frame_id, node_name,
+                           event, outputs, elapsed):
+        """Continuation: a parked async LOCAL stage completed (the local
+        analogue of ``process_frame_response``)."""
+        stream = self.streams.get(str(stream_id))
+        if stream is None:
+            return                      # stream destroyed while parked
+        frame = stream.frames.get(int(frame_id))
+        if frame is None or frame.paused_pe_name != node_name:
+            return
+        frame.paused_pe_name = None
+        frame.metrics[f"{node_name}_time"] = elapsed
+        self.run_hook("pipeline.process_element_post:0",
+                      lambda: {"element": node_name,
+                               "frame": frame.frame_id,
+                               "event": event, "time": elapsed})
+        outputs = outputs if isinstance(outputs, dict) else {}
+        node = self.graph.get_node(node_name)
+        if event in (StreamEvent.OKAY, StreamEvent.LOOP_END):
+            self._map_out(node, frame.swag, outputs)
+            nodes = self.graph.iterate_after(node_name, stream.graph_path)
+            self._process_frame_common(stream, frame, nodes=nodes)
+            return
+        if event == StreamEvent.DROP_FRAME:
+            frame.metrics["dropped"] = True
+            self._frame_done(stream, frame, None)
+            return
+        if event == StreamEvent.STOP:
+            self._map_out(node, frame.swag, outputs)
+            stream.state = StreamState.STOP
+            self._frame_done(stream, frame, None)
+            return
+        diagnostic = outputs.get("diagnostic", "") \
+            if event == StreamEvent.ERROR else f"bad event {event!r}"
+        self._frame_error(stream, frame, f"{node_name}: {diagnostic}")
 
     def retry_frame(self, stream_id, frame: Frame):
         stream = self.streams.get(str(stream_id))
@@ -666,7 +753,11 @@ class Pipeline(Actor):
             next_due = time.monotonic()
             while not stop_event.is_set() and stream.state in (
                     StreamState.START, StreamState.RUN):
-                if engine.mailbox_size(mailbox) >= _BACKPRESSURE_DEPTH:
+                # Backpressure counts queued AND parked frames: async
+                # stages hold frames out of the mailbox while in flight,
+                # and a source must not outrun them unboundedly.
+                if engine.mailbox_size(mailbox) + stream.in_flight \
+                        >= _BACKPRESSURE_DEPTH:
                     time.sleep(_BACKPRESSURE_SLEEP)
                     continue
                 try:

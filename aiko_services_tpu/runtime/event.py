@@ -160,11 +160,29 @@ class EventEngine:
                 fn, "__qualname__", fn))
 
     def post(self, fn: Callable, *args):
-        """Thread-safe: run ``fn(*args)`` on the event loop ASAP."""
+        """Thread-safe: run ``fn(*args)`` on the event loop ASAP.  From
+        the loop thread itself this is a SYNCHRONOUS call."""
         loop = self._loop
         if loop is not None and self._running:
             if threading.get_ident() == self._loop_thread_id:
                 self._call(fn, *args)
+                self._signal()
+            else:
+                loop.call_soon_threadsafe(self._call, fn, *args)
+        else:
+            with self._lock:
+                self._pending_pre_loop.append(lambda: self._call(fn, *args))
+
+    def post_deferred(self, fn: Callable, *args):
+        """Thread-safe: run ``fn(*args)`` on the event loop on a FUTURE
+        loop iteration -- never synchronously, even from the loop thread.
+        Pump-style handlers that re-post themselves use this so queued
+        mailbox work (new requests, frame ingests) interleaves between
+        invocations instead of the pump recursing to completion."""
+        loop = self._loop
+        if loop is not None and self._running:
+            if threading.get_ident() == self._loop_thread_id:
+                loop.call_soon(self._call, fn, *args)
                 self._signal()
             else:
                 loop.call_soon_threadsafe(self._call, fn, *args)

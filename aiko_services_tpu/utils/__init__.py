@@ -1,5 +1,5 @@
 from .sexpr import generate, generate_value, parse, parse_value, \
-    parse_number, parse_to_dict, SExprError
+    parse_bool, parse_number, parse_to_dict, SExprError
 from .graph import Graph, Node, GraphError
 from .configuration import (
     get_namespace, get_hostname, get_pid, get_username, get_transport,

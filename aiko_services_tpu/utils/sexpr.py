@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Any
 
-__all__ = ["generate", "parse", "parse_number", "parse_to_dict"]
+__all__ = ["generate", "parse", "parse_bool", "parse_number", "parse_to_dict"]
 
 
 def generate(command: str, parameters: Any = None) -> str:
@@ -205,6 +205,17 @@ def _parse_atom(text: str, i: int):
 
 # --------------------------------------------------------------------------
 # Helpers
+
+def parse_bool(value, default: bool = False) -> bool:
+    """Truthy-string parameter normalization, shared by every
+    boolean-ish element parameter (``synchronous``, ``streaming``,
+    ``quantize``...): accepts real bools and the usual spellings."""
+    if isinstance(value, bool):
+        return value
+    if value is None:
+        return default
+    return str(value).strip().lower() in ("true", "1", "yes", "on")
+
 
 def parse_number(token, default=None):
     """Best-effort conversion of a wire token to int/float/bool."""
