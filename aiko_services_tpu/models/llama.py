@@ -49,6 +49,21 @@ class LlamaConfig:
     # serving path).  Applies to prefill_into_slot, the continuous
     # batcher's admission path; decode is O(1)-query and stays dense.
     attention: str = "dense"
+    # Decode attention implementation: "dense" (ops/layers.py
+    # attention_decode_append), "flash" (the split-K Pallas kernel,
+    # ops/pallas_decode.py -- streams the cache once, softmax stats in
+    # VMEM, int8 cache dequantized in-kernel), or "auto" (flash once the
+    # cache extent reaches ``flash_decode_threshold`` -- resolved at
+    # trace time, the cache length is static under jit).  The dense
+    # path's [B, H, T] HBM intermediates cost more than the cache
+    # itself at long context (BENCH_r03: 0.44 HBM util at 8k vs 0.78 at
+    # 1k); short contexts keep dense, whose single fused dispatch has
+    # less per-call overhead.  NOTE: pallas_call has no GSPMD
+    # partitioning rules, so under a tp-sharded cache keep "dense" (or
+    # shard_map the layer); single-chip and dp-sharded serving -- the
+    # benched configs -- compose fine.
+    decode_attention: str = "auto"
+    flash_decode_threshold: int = 4096
     # KV cache storage: "bfloat16" or "int8" (per-token-per-head scales,
     # models/quant.py:quantize_kv).  Decode streams the whole cache every
     # step, so at long context the cache -- not the weights -- dominates
@@ -77,6 +92,10 @@ class LlamaConfig:
             raise ValueError(
                 f"attention must be 'dense' or 'flash', "
                 f"got {self.attention!r}")
+        if self.decode_attention not in ("dense", "flash", "auto"):
+            raise ValueError(
+                f"decode_attention must be 'dense', 'flash' or 'auto', "
+                f"got {self.decode_attention!r}")
         if self.kv_dtype not in ("bfloat16", "int8"):
             raise ValueError(
                 f"kv_dtype must be 'bfloat16' or 'int8', "
@@ -611,6 +630,10 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
     b = tokens.shape[0]
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     positions = lengths[:, None]                       # [B, 1]
+    cache_extent = cache_array(cache).shape[2]
+    use_flash = c.decode_attention == "flash" or (
+        c.decode_attention == "auto"
+        and cache_extent >= c.flash_decode_threshold)
 
     def factory(k_layer, v_layer):
         def kv_write(q, k, v):
@@ -620,6 +643,13 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
             # k/v leave the scan (see _forward_layers / the post-scan
             # scatter below).
             kv_write.updated = (k, v)
+            if use_flash:
+                # Split-K Pallas kernel: cache streamed once, no
+                # [B, H, T] HBM intermediates, int8 dequantized
+                # in-kernel (ops/pallas_decode.py).
+                from ..ops.pallas_decode import flash_decode_append
+                return flash_decode_append(q, k_layer, v_layer, k, v,
+                                           lengths)
             return attention_decode_append(q, k_layer, v_layer, k, v,
                                            lengths)
         return kv_write

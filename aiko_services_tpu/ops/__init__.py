@@ -1,3 +1,6 @@
 from .layers import (rms_norm, rope_frequencies, apply_rope, swiglu,
                      repeat_kv, attention_prefill, attention_decode,
                      attention_decode_append)
+# ops.pallas_attention / ops.pallas_decode are imported lazily at first
+# use (llama.decode_step, prefill_into_slot) so the package import does
+# not pay for jax.experimental.pallas; import them by module path.

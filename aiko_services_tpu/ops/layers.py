@@ -173,7 +173,21 @@ def attention_decode_append(q: jax.Array, k_cache: jax.Array,
     the weighted sum, each adding error at its int8 step size (~0.4%
     of the row maximum); the softmax denominator stays exact-float,
     so weight truncation can only shrink the output, never inflate it
-    (see the inline sink-token analysis).  k_new/
+    (see the inline sink-token analysis).
+
+    DOCUMENTED WORST CASE (diffuse attention): the per-weight bound
+    does NOT bound the aggregate dropped mass.  With one spike and a
+    long tail of positions each under half the int8 step (weight <
+    row_max/254), every tail weight quantizes to zero: at T=8k a
+    tail carrying ~97% of the attention mass shrinks the output to
+    the spike's few percent (tests/test_flash_decode.py::
+    test_dense_int8_diffuse_tail_error_mode quantifies it).  Diffuse
+    long-context attention is exactly the int8-KV regime, so for
+    T >= LlamaConfig.flash_decode_threshold the decode path defaults
+    to the split-K Pallas kernel (ops/pallas_decode.py,
+    decode_attention="auto"), which dequantizes IN KERNEL -- no
+    query or weight quantization at all -- and this dense int8 path
+    remains only an explicit short-context opt-in.  k_new/
     v_new: [B, 1, K, hd]; lengths: [B] valid cache positions (NOT
     counting the current token).  Returns [B, 1, H, hd].
     """

@@ -1,0 +1,352 @@
+"""Flash-decode attention: split-K over the KV cache as a Pallas TPU
+kernel (the long-context serving path).
+
+Decode attention is bandwidth-bound -- each step streams the whole cache
+once -- but the dense path (ops/layers.py:attention_decode_append)
+materializes the [B, H, T] score/weight intermediates in HBM: at 8k
+context that chain (logits write, mask, max, exp, sum, cast, dot) moves
+more bytes than the cache itself, which is why measured HBM utilization
+collapsed from 0.78 at 1k to 0.44 at 8k (BENCH_r03).  Here the cache is
+the ONLY large HBM traffic: K/V blocks stream HBM->VMEM through the
+BlockSpec pipeline, scores and online-softmax statistics live in VMEM
+scratch across the T grid axis, and one [H, K*hd] accumulator is written
+per batch row.
+
+Layout choices (same trick as the dense path's docstring, kept because
+it is the MXU-friendly formulation):
+
+- the cache is consumed as [B, T, K*hd] -- its natural contiguous view
+  -- and GQA is expressed as block-diagonal matmuls: queries are
+  zero-padded to the full K*hd width (done once outside, q is tiny), so
+  scores = q_pad @ k_blk^T contracts over K*hd (lane-aligned: 512 at
+  llama head layout) and the weighted sum is [H, Tb] @ [Tb, K*hd];
+- int8 caches are dequantized IN KERNEL: the HBM stream is int8 bytes
+  (the entire point at long context), the VMEM cast rides the MXU
+  shadow, and the per-(t, k) scales fold into the f32 scores (keys) and
+  softmax weights (values) -- both EXACT, because each scale is constant
+  along the contracted head_dim.  Unlike the dense int8 path there is
+  NO query or softmax-weight quantization, so the diffuse-attention
+  error mode of weight quantization (ADVICE r3) does not exist here;
+- blocks wholly beyond a row's ``length`` clamp their DMA index to the
+  last live block (fetch skipped, compute skipped via pl.when), so
+  short rows in a ragged batch do not pay full-T bandwidth;
+- the kernel returns UNNORMALIZED (acc, m, l) partial softmax stats;
+  the caller merges the current token's self-attention term outside
+  (exactly the split the dense path uses) -- see
+  :func:`flash_decode_append`.
+
+On non-TPU backends the kernel runs in interpret mode, so tests exercise
+the identical code path on the CPU mesh (SURVEY.md section 4 strategy).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:                               # pragma: no cover
+    pltpu = None
+
+__all__ = ["flash_decode_attention", "flash_decode_append"]
+
+
+def is_quantized(leaf) -> bool:
+    """Quantized cache/weight leaf (same shape contract as
+    models/quant.py:is_quantized; duplicated here so ops never imports
+    the models package -- models imports ops)."""
+    return isinstance(leaf, dict) and "int8" in leaf and "scale" in leaf
+
+_NEG_INF = -1e30
+_STAT_LANES = 128
+
+
+def _group_onehot(h: int, n_kv: int, dtype, groups: int | None = None):
+    """[H, K] 0/1 matrix mapping query head -> its kv head (built from
+    iotas so it also works inside the kernel).  ``groups`` is the TRUE
+    queries-per-kv-head count -- it must be passed explicitly when ``h``
+    is sublane-PADDED (padded rows map to no kv head: all-zero rows,
+    harmless, sliced off outside)."""
+    groups = groups or (h // n_kv)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (h, n_kv), 0) // groups
+    cols = jax.lax.broadcasted_iota(jnp.int32, (h, n_kv), 1)
+    return (rows == cols).astype(dtype)
+
+
+def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                   o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr, *,
+                   block_t, n_heads, n_kv, groups, compute_dtype,
+                   quantized):
+    b = pl.program_id(0)
+    ti = pl.program_id(1)
+    nt = pl.num_programs(1)
+    length = lengths_ref[b]
+    t_start = ti * block_t
+
+    @pl.when(ti == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    live = t_start < length
+    # Interior blocks (every position valid) skip the iota/mask VPU work
+    # -- at full context that is all blocks but the last.
+    interior = t_start + block_t <= length
+
+    def _scores():
+        k_blk = k_ref[0]
+        if quantized:
+            k_blk = k_blk.astype(compute_dtype)
+        s = jax.lax.dot_general(
+            q_ref[0], k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [H, Tb]
+        if quantized:
+            # Key scales are constant along the contracted K*hd axis
+            # (each head only reads its own kv block out of the
+            # block-diagonal product), so applying them to the scores is
+            # exact dequantization: scale_h = onehot @ ks  ([H, Tb]).
+            onehot = _group_onehot(n_heads, n_kv, jnp.float32,
+                                   groups=groups)
+            s = s * jax.lax.dot_general(
+                onehot, ks_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return s
+
+    def _online_update(s, p_mask=None):
+        m_prev = m_scr[:, :1]                             # [H, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe)                           # [H, Tb] f32
+        if p_mask is not None:
+            p = jnp.where(p_mask, p, jnp.zeros_like(p))
+        correction = jnp.exp(m_prev - m_safe)
+        # The denominator sums the UNSCALED weights (the softmax
+        # normalizer) -- value scales fold into the numerator only.
+        l_scr[...] = jnp.broadcast_to(
+            l_prev * correction
+            + jnp.sum(p, axis=1, keepdims=True, dtype=jnp.float32),
+            l_scr.shape)
+        v_blk = v_ref[0]
+        if quantized:
+            # Value scales fold into the weights -- exact for the same
+            # constant-along-hd reason; the weights themselves stay
+            # float (NO int8 weight quantization: the dense path's
+            # diffuse-tail truncation mode does not exist here).
+            onehot = _group_onehot(n_heads, n_kv, jnp.float32,
+                                   groups=groups)
+            p = p * jax.lax.dot_general(
+                onehot, vs_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            v_blk = v_blk.astype(compute_dtype)
+        pv = jax.lax.dot_general(
+            p.astype(compute_dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [H, K*hd]
+        acc_scr[...] = acc_scr[...] * correction + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(jnp.logical_and(live, interior))
+    def _compute_interior():
+        _online_update(_scores())
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(interior)))
+    def _compute_boundary():
+        t_pos = t_start + jax.lax.broadcasted_iota(
+            jnp.int32, (n_heads, block_t), 1)
+        mask = t_pos < length
+        _online_update(jnp.where(mask, _scores(), _NEG_INF),
+                       p_mask=mask)
+
+    @pl.when(ti == nt - 1)
+    def _finalize():
+        o_ref[0] = acc_scr[...]
+        m_ref[0] = m_scr[...]
+        l_ref[0] = l_scr[...]
+
+
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _round_up(n, multiple):
+    return -(-n // multiple) * multiple
+
+
+@functools.partial(jax.jit, static_argnames=("block_t", "interpret"))
+def flash_decode_attention(q_pad, k_flat, v_flat, k_scale_t, v_scale_t,
+                           lengths, *, block_t: int = 512,
+                           interpret: bool | None = None):
+    """Split-K decode attention over the cache; returns partial stats.
+
+    q_pad: [B, H, C] block-diagonal padded queries (C = K*hd), softmax
+    scale already folded in; k_flat/v_flat: [B, T, C] cache views (bf16,
+    or int8 when quantized); k_scale_t/v_scale_t: [B, K, T] f32
+    per-position scales (quantized caches) or None; lengths: [B] valid
+    positions.  Returns (acc [B, H, C] f32 unnormalized, m [B, H] f32
+    running max, l [B, H] f32 denominator) -- merge the current token's
+    self term with :func:`flash_decode_append`'s combine step.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    quantized = k_scale_t is not None
+    b, h, c = q_pad.shape
+    t = k_flat.shape[1]
+    n_kv = k_scale_t.shape[1] if quantized else None
+
+    h_pad = _round_up(max(h, 8), 8)
+    q_pad = _pad_to(q_pad, 1, h_pad)
+    block_t = min(block_t, _round_up(max(t, 8), 8))
+    k_flat = _pad_to(k_flat, 1, block_t)
+    v_flat = _pad_to(v_flat, 1, block_t)
+    t_pad = k_flat.shape[1]
+
+    if not quantized:
+        # n_kv only matters for scale expansion; any divisor works for
+        # the (unused) onehot shape -- use 1 so H % n_kv always holds.
+        n_kv = 1
+        k_scale_t = jnp.zeros((b, 1, t_pad), dtype=jnp.float32)
+        v_scale_t = jnp.zeros((b, 1, t_pad), dtype=jnp.float32)
+    else:
+        k_scale_t = _pad_to(k_scale_t, 2, block_t)
+        v_scale_t = _pad_to(v_scale_t, 2, block_t)
+
+    grid = (b, t_pad // block_t)
+    compute_dtype = q_pad.dtype if q_pad.dtype != jnp.float32 \
+        else jnp.float32
+
+    def _clamped(bi, ti, lengths):
+        # Blocks wholly beyond this row's length clamp to the last live
+        # block: pl.when skips the compute, the repeated index skips
+        # the HBM->VMEM DMA -- a short row in a ragged batch reads only
+        # its own extent, not full T.
+        last_live = jnp.maximum(
+            pl.cdiv(lengths[bi], block_t) - 1, 0)
+        return jnp.minimum(ti, last_live)
+
+    def kv_block(bi, ti, lengths):
+        return (bi, _clamped(bi, ti, lengths), 0)
+
+    def scale_block(bi, ti, lengths):
+        # Scales are [B, K, T]: the T axis is dim 2 here, not dim 1.
+        return (bi, 0, _clamped(bi, ti, lengths))
+
+    kernel = functools.partial(
+        _decode_kernel, block_t=block_t, n_heads=h_pad, n_kv=n_kv,
+        groups=max(h // n_kv, 1), compute_dtype=compute_dtype,
+        quantized=quantized)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h_pad, c), lambda bi, ti, lengths: (bi, 0, 0)),
+            pl.BlockSpec((1, block_t, c), kv_block),
+            pl.BlockSpec((1, block_t, c), kv_block),
+            pl.BlockSpec((1, n_kv, block_t), scale_block),
+            pl.BlockSpec((1, n_kv, block_t), scale_block),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h_pad, c), lambda bi, ti, lengths: (bi, 0, 0)),
+            pl.BlockSpec((1, h_pad, _STAT_LANES),
+                         lambda bi, ti, lengths: (bi, 0, 0)),
+            pl.BlockSpec((1, h_pad, _STAT_LANES),
+                         lambda bi, ti, lengths: (bi, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h_pad, c), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h_pad, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_pad, _STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_pad, _STAT_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(lengths, dtype=jnp.int32), q_pad, k_flat, v_flat,
+      k_scale_t, v_scale_t)
+    return acc[:, :h], m[:, :h, 0], l[:, :h, 0]
+
+
+def flash_decode_append(q, k_cache, v_cache, k_new, v_new, lengths, *,
+                        block_t: int = 512,
+                        interpret: bool | None = None):
+    """Drop-in replacement for
+    :func:`~aiko_services_tpu.ops.layers.attention_decode_append`
+    (same signature and semantics) built on the split-K kernel.
+
+    q: [B, 1, H, hd]; k_cache/v_cache: [B, T, K, hd] grouped caches --
+    raw bf16 arrays or int8-quantized layers (``{"int8", "scale"}``,
+    dequantized IN KERNEL, see module docstring); k_new/v_new:
+    [B, 1, K, hd] the current token's raw k/v (not yet written);
+    lengths: [B] valid cache positions.  Returns [B, 1, H, hd].
+    """
+    b, _, h, d = q.shape
+    if is_quantized(k_cache):
+        k_payload = k_cache["int8"]
+        k_scale_t = k_cache["scale"][..., 0].transpose(0, 2, 1) \
+            .astype(jnp.float32)                          # [B, K, T]
+    else:
+        k_payload, k_scale_t = k_cache, None
+    if is_quantized(v_cache):
+        v_payload = v_cache["int8"]
+        v_scale_t = v_cache["scale"][..., 0].transpose(0, 2, 1) \
+            .astype(jnp.float32)
+    else:
+        v_payload, v_scale_t = v_cache, None
+    t, kv = k_payload.shape[1], k_payload.shape[2]
+    c = kv * d
+
+    scale = d ** -0.5
+    blocks = jnp.arange(h) // (h // kv)                   # [H] kv head
+    onehot = _group_onehot(h, kv, q.dtype)                # [H, K]
+    q_flat = q[:, 0]                                      # [B, H, hd]
+    # Fold the softmax scale into the padded queries -- lossless when
+    # d**-0.5 is a power of two (d = 64), otherwise folded in f32 and
+    # rounded once (same rounding the dense path's f32 product takes).
+    q_scaled = (q_flat.astype(jnp.float32) * scale).astype(q.dtype) \
+        if math.log2(scale).is_integer() \
+        else (q_flat.astype(jnp.float32) * scale)
+    q_pad = jnp.einsum("bhd,hk->bhkd", q_scaled,
+                       onehot.astype(q_scaled.dtype)).reshape(b, h, c)
+
+    acc, m, l = flash_decode_attention(
+        q_pad, k_payload.reshape(b, t, c), v_payload.reshape(b, t, c),
+        k_scale_t, v_scale_t, lengths,
+        block_t=block_t, interpret=interpret)
+
+    # Merge the current token's self-attention term (exact two-part
+    # softmax combine, mirroring the dense path's cache/self split).
+    k_new_h = k_new[:, 0][:, blocks, :]                   # [B, H, hd]
+    v_new_h = v_new[:, 0][:, blocks, :]
+    self_logits = (q_flat.astype(jnp.float32)
+                   * k_new_h.astype(jnp.float32)).sum(-1) * scale
+    m_joint = jnp.maximum(m, self_logits)
+    correction = jnp.where(m <= _NEG_INF / 2, 0.0,
+                           jnp.exp(m - m_joint))          # [B, H]
+    self_weight = jnp.exp(self_logits - m_joint)
+    denominator = l * correction + self_weight
+    # Select each head's own kv block out of the fused accumulator.
+    cache_part = jnp.einsum(
+        "bhkd,hk->bhd", acc.reshape(b, h, kv, d),
+        onehot.astype(jnp.float32))                       # [B, H, hd]
+    out = (cache_part * correction[:, :, None]
+           + self_weight[:, :, None] * v_new_h.astype(jnp.float32)) \
+        / denominator[:, :, None]
+    return out.reshape(q.shape).astype(q.dtype)
