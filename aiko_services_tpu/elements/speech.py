@@ -87,9 +87,8 @@ class ASR(PipelineElement):
             checkpoint)
 
     def _streaming(self) -> bool:
-        streaming, _ = self.get_parameter("streaming", False)
-        return str(streaming).strip().lower() in ("true", "1", "yes",
-                                                  "on")
+        from ..utils import parse_bool
+        return parse_bool(self.get_parameter("streaming", False)[0])
 
     def process_frame(self, stream, audio=None, sample_rate=16000,
                       **inputs):
@@ -108,9 +107,22 @@ class ASR(PipelineElement):
         if self._streaming():
             streamer = self._streamers.get(stream.stream_id)
             if streamer is None:
-                streamer = asr_model.StreamingAsr(self._params, config)
+                # hop_seconds: sub-chunk live hypothesis every hop;
+                # endpoint_silence: trailing quiet finalizes the
+                # utterance early (models/asr.py StreamingAsr).
+                hop, _ = self.get_parameter("hop_seconds", None)
+                endpoint, _ = self.get_parameter("endpoint_silence",
+                                                 None)
+                streamer = asr_model.StreamingAsr(
+                    self._params, config,
+                    hop_seconds=float(hop) if hop else None,
+                    endpoint_silence=float(endpoint) if endpoint
+                    else None)
                 self._streamers[stream.stream_id] = streamer
-            return StreamEvent.OKAY, {"text": streamer.push(samples)}
+            text = streamer.push(samples)
+            return StreamEvent.OKAY, {
+                "text": text, "partial_text": streamer.partial_text,
+                "stable_text": streamer.stable_text}
         chunk = int(config.sample_rate * config.chunk_seconds)
         true_rows = max(1, -(-len(samples) // chunk))
         rows = _chunk_rows(samples, chunk, self._bucketer)

@@ -232,3 +232,63 @@ def test_tts_fits_mel_targets():
     # And the full path still yields a bounded waveform.
     wave = tts_model.synthesize(params, config, "ab")
     assert np.isfinite(wave).all() and np.abs(wave).max() <= 1.0 + 1e-5
+
+
+def test_subchunk_streaming_partial_latency(fitted_asr):
+    """VERDICT r3 item 6: with hop_seconds set, a live hypothesis is
+    produced every hop -- per-push latency is bounded by the HOP, not
+    chunk_seconds -- and the finalized text still equals the whole-chunk
+    decode exactly."""
+    config, params = fitted_asr
+    rng = np.random.default_rng(41)
+    hop_seconds = config.chunk_seconds / 4
+    streamer = asr_model.StreamingAsr(params, config,
+                                      hop_seconds=hop_seconds)
+    chunk_audio = tone_chunk(config, TONES["a"], rng)
+    reference = asr_model.decode_text(
+        config, np.asarray(asr_model.transcribe(
+            params, config, jnp.asarray(chunk_audio[None])))[0])
+
+    pieces = np.array_split(chunk_audio, 4)
+    final = streamer.push(pieces[0])
+    # A quarter-chunk push already produced a live hypothesis: the
+    # first-word latency is one hop, not the 10x longer chunk.
+    assert final == ""
+    assert streamer.partial_decodes >= 1
+    assert isinstance(streamer.partial_text, str)
+    first_partial = streamer.partial_text
+
+    final += streamer.push(pieces[1])
+    # Two consecutive hypotheses over the same tone agree: the stable
+    # prefix holds the agreed text.
+    if streamer.partial_text == first_partial:
+        assert streamer.stable_text == first_partial
+    final += streamer.push(pieces[2])
+    final += streamer.push(pieces[3])
+    assert final == reference           # finalized == whole-chunk decode
+    assert streamer.partial_text == ""  # partial state reset at finalize
+
+
+def test_streaming_endpoint_finalizes_early(fitted_asr):
+    """Energy endpointing: speech followed by trailing silence
+    finalizes the utterance immediately -- no waiting for the chunk to
+    fill."""
+    config, params = fitted_asr
+    rng = np.random.default_rng(43)
+    chunk = int(config.sample_rate * config.chunk_seconds)
+    streamer = asr_model.StreamingAsr(params, config,
+                                      endpoint_silence=0.1,
+                                      endpoint_threshold=0.05)
+    speech = tone_chunk(config, TONES["b"], rng)[:int(chunk * 0.4)]
+    silence = np.zeros(int(chunk * 0.15), dtype=np.float32)
+
+    assert streamer.push(speech) == ""          # no endpoint yet
+    text = streamer.push(silence)               # trailing quiet >= 0.1 s
+    reference = asr_model.decode_text(
+        config, np.asarray(asr_model.transcribe(
+            params, config, jnp.asarray(asr_model.pad_audio(
+                config, np.concatenate([speech, silence]))[None])))[0])
+    assert text == reference and text != ""     # finalized early, exact
+    assert len(streamer._pending) == 0          # utterance consumed
+    # Pure silence afterwards never endpoints (no speech to finalize).
+    assert streamer.push(np.zeros(chunk // 2, np.float32)) == ""
