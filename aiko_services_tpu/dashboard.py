@@ -189,6 +189,59 @@ class DashboardModel:
         self.runtime.message.publish(f"{self.selected}/in",
                                      generate("stop", []))
 
+    def kill_selected(self, kill=None) -> bool:
+        """Kill the selected service's host PROCESS (SIGKILL) -- the
+        hard counterpart of ``stop_selected``'s polite ``(stop)``
+        (reference dashboard.py:399-408 _kill_service).  Topic paths
+        are ``namespace/hostname/pid/service_id``; like the reference,
+        only a process on THIS host can be killed (its documented
+        same-system limitation made explicit).  Returns True when a
+        kill was issued."""
+        if self.selected is None:
+            return False
+        parts = self.selected.split("/")
+        if len(parts) < 4 or not parts[-2].isdigit():
+            return False
+        if parts[-3] != self.runtime.hostname:
+            _logger.warning("kill_selected: %s is not on this host",
+                            self.selected)
+            return False
+        pid = int(parts[-2])
+        if pid == int(self.runtime.pid):    # runtime.pid is a string
+            return False              # the dashboard's own process
+        import os
+        import signal
+        (kill or os.kill)(pid, signal.SIGKILL)
+        return True
+
+    def copy_selected_topic(self, copier=None) -> tuple[str, bool] | None:
+        """Copy the selected topic path to the system clipboard
+        (reference dashboard.py:519-520, pyperclip).  Returns
+        ``(topic_path, copied)`` -- ``copied`` False when no clipboard
+        helper succeeded (a terminal UI can then fall back to OSC 52)
+        -- or None when nothing is selected."""
+        if self.selected is None:
+            return None
+        text = self.selected
+        if copier is not None:
+            copier(text)
+            return text, True
+        import shutil
+        import subprocess
+        for tool, args in (("wl-copy", []), ("xclip", ["-selection",
+                                                       "clipboard"]),
+                           ("xsel", ["--clipboard", "--input"]),
+                           ("pbcopy", [])):
+            path = shutil.which(tool)
+            if path:
+                try:
+                    subprocess.run([path, *args], input=text.encode(),
+                                   timeout=2.0, check=True)
+                    return text, True
+                except Exception:                 # pragma: no cover
+                    continue
+        return text, False
+
     def selected_record(self):
         for record in self.services():
             if record.topic_path == self.selected:
@@ -262,7 +315,7 @@ def _dashboard_loop(stdscr, runtime, model):          # pragma: no cover
     show_log = False
     raw_view = False          # 'v': raw share dict instead of plugin view
     status = ("q quit | enter select | l logs | v raw/plugin | u update "
-              "| k stop service")
+              "| k stop | K kill | c copy topic")
 
     while True:
         records = model.services()
@@ -327,8 +380,20 @@ def _dashboard_loop(stdscr, runtime, model):          # pragma: no cover
             parts = name_value.split(None, 1)
             if len(parts) == 2:
                 runtime.engine.post(model.update_share, parts[0], parts[1])
-        elif key in (ord("k"), ord("K")) and model.selected:
+        elif key == ord("k") and model.selected:
             runtime.engine.post(model.stop_selected)
+        elif key == ord("K") and model.selected:
+            model.kill_selected()     # direct os.kill: no engine hop
+        elif key == ord("c") and model.selected:
+            result = model.copy_selected_topic()
+            if result is not None and not result[1]:
+                # No clipboard helper on this host: the OSC 52 escape
+                # reaches the terminal's clipboard even over SSH.
+                import base64
+                import sys
+                payload = base64.b64encode(result[0].encode()).decode()
+                sys.stdout.write(f"\x1b]52;c;{payload}\x07")
+                sys.stdout.flush()
 
 
 def _prompt(stdscr, label):                           # pragma: no cover

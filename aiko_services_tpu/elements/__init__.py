@@ -11,8 +11,8 @@ from .image import (ImageReadFile, ImageWriteFile, ImageResize,
 from .video import (VideoReadFile, VideoWriteFile, VideoSample,
                     VideoOutput, VideoReadWebcam)
 from .audio import (AudioReadFile, AudioWriteFile, AudioFraming,
-                    AudioResampler, AudioFFT, AudioOutput, read_wav,
-                    write_wav)
+                    AudioResampler, AudioFFT, AudioGraphXY, AudioOutput,
+                    read_wav, write_wav)
 from .audio_live import (MicrophoneRead, SpeakerWrite, DataSchemeMic,
                          DataSchemeSpeaker)
 from .scheme_rtsp import DataSchemeRTSP, VideoReadRTSP, VideoWriteRTSP

@@ -21,7 +21,7 @@ from ..pipeline import DataSource, DataTarget, PipelineElement, StreamEvent
 from .scheme_file import DataSchemeFile
 
 __all__ = ["AudioReadFile", "AudioWriteFile", "AudioFraming",
-           "AudioResampler", "AudioFFT", "AudioOutput",
+           "AudioResampler", "AudioFFT", "AudioGraphXY", "AudioOutput",
            "read_wav", "write_wav"]
 
 
@@ -145,6 +145,55 @@ class AudioFFT(PipelineElement):
         spectrum = jnp.abs(jnp.fft.rfft(mono.astype(jnp.float32),
                                         axis=-1))
         return StreamEvent.OKAY, {"spectrum": spectrum,
+                                  "sample_rate": sample_rate}
+
+
+class AudioGraphXY(PipelineElement):
+    """Render the magnitude spectrum as an amplitude-vs-frequency plot
+    IMAGE (reference audio_io.py:334 PE_GraphXY, which pygal-renders a
+    PNG and cv2.imshows it in a window; here the plot is an ordinary
+    ``image`` array [height, width, 3] uint8, so it composes with the
+    existing image sinks -- ImageWriteFile, VideoWriteRTSP, overlays --
+    instead of needing a display).
+
+    Input ``spectrum`` [windows, bins] (AudioFFT output; the windows
+    are averaged) or [bins].  Parameters: ``width``/``height`` (plot
+    pixels), ``max_frequency`` (clip the x axis; default Nyquist).
+    Outputs the plot as ``image`` and passes ``spectrum`` through.
+    """
+
+    def process_frame(self, stream, spectrum=None, sample_rate=16000,
+                      **inputs):
+        data = np.asarray(spectrum, dtype=np.float32)
+        if data.ndim == 2:
+            data = data.mean(axis=0)
+        bins = data.shape[0]
+        width = int(self.get_parameter("width", 512)[0])
+        height = int(self.get_parameter("height", 256)[0])
+        nyquist = float(sample_rate) / 2.0
+        max_frequency, found = self.get_parameter("max_frequency", None)
+        if found and max_frequency:
+            keep = max(1, int(bins * min(1.0, float(max_frequency)
+                                         / max(nyquist, 1e-9))))
+            data = data[:keep]
+            bins = keep
+        # Per-column peak over each column's bin range (reduceat gives
+        # the vectorized ragged max), scaled to pixel heights.
+        edges = np.floor(np.linspace(0, bins, width,
+                                     endpoint=False)).astype(np.int64)
+        edges = np.maximum.accumulate(edges)     # monotonic for reduceat
+        columns = np.maximum.reduceat(data, edges) if bins >= width \
+            else data[np.minimum(edges, bins - 1)]
+        peak = float(columns.max())
+        heights = np.zeros(width, dtype=np.int64) if peak <= 0 else \
+            np.round(columns / peak * (height - 1)).astype(np.int64)
+        rows = np.arange(height)[:, None]        # row 0 = top
+        bars = rows >= (height - 1 - heights)[None, :]
+        image = np.zeros((height, width, 3), dtype=np.uint8)
+        image[..., :] = (16, 16, 32)             # background
+        image[bars] = (64, 200, 120)             # spectrum bars
+        image[-1, :, :] = 255                    # frequency axis
+        return StreamEvent.OKAY, {"image": image, "spectrum": spectrum,
                                   "sample_rate": sample_rate}
 
 

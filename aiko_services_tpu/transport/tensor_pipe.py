@@ -29,6 +29,7 @@ from __future__ import annotations
 import ctypes
 import json
 import queue
+import socket
 import threading
 
 import numpy as np
@@ -80,6 +81,20 @@ def _library() -> ctypes.CDLL:
     return _LIBRARY
 
 
+def _resolve(host: str) -> str:
+    """Hostname -> numeric IPv4 (the C library speaks inet_pton AF_INET
+    only; resolving here keeps getaddrinfo/DNS out of the native code
+    and gives a real error message for unresolvable names)."""
+    try:
+        infos = socket.getaddrinfo(host, None, socket.AF_INET,
+                                   socket.SOCK_STREAM)
+    except socket.gaierror as error:
+        raise ConnectionError(
+            f"tensor_pipe: cannot resolve host {host!r}: {error}") \
+            from error
+    return infos[0][4][0]
+
+
 def encode_header(array: np.ndarray, name: str) -> bytes:
     return json.dumps({"dtype": str(array.dtype),
                        "shape": list(array.shape),
@@ -97,8 +112,8 @@ class TensorPipeClient:
 
     def __init__(self, host: str, port: int, timeout: float = 5.0):
         self._lib = _library()
-        self._fd = self._lib.tp_connect(host.encode(), int(port),
-                                        int(timeout * 1000))
+        self._fd = self._lib.tp_connect(_resolve(host).encode(),
+                                        int(port), int(timeout * 1000))
         if self._fd < 0:
             raise ConnectionError(f"tensor_pipe connect "
                                   f"{host}:{port} failed")
@@ -133,9 +148,18 @@ class TensorPipeServer:
     producers)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 queue_depth: int = 64):
+                 queue_depth: int = 64,
+                 max_payload: int = 64 * 1024 * 1024):
+        # max_payload caps what a single peer can make this server
+        # allocate (default 64 MB: plenty for video frames / model
+        # tensors); a frame advertising more drops the CONNECTION --
+        # the stream is misaligned or hostile, not just oversized.
+        # The C side's own 4 GiB kMaxPayload stays as the wire-format
+        # sanity bound.
         self._lib = _library()
-        self._server_fd = self._lib.tp_listen(host.encode(), int(port))
+        self._max_payload = int(max_payload)
+        self._server_fd = self._lib.tp_listen(_resolve(host).encode(),
+                                              int(port))
         if self._server_fd < 0:
             raise OSError(f"tensor_pipe listen {host}:{port} failed")
         self.port = self._lib.tp_port(self._server_fd)
@@ -171,6 +195,9 @@ class TensorPipeServer:
                 continue           # clean timeout: keep polling
             if rc != 0:
                 break              # closed / torn / corrupt: drop conn
+            if payload_len.value > self._max_payload:
+                break              # oversized advert: drop conn (cap
+                                   # peer-driven allocations)
             header = ctypes.create_string_buffer(header_len.value)
             payload = (ctypes.c_char * payload_len.value)()
             if self._lib.tp_recv_body(
@@ -208,10 +235,14 @@ class TensorPipeServer:
     # -- API ---------------------------------------------------------------
 
     def recv(self, timeout: float | None = None):
-        """(name, array) or None on timeout."""
+        """(name, array), or None on timeout.  ``timeout=None`` (the
+        default) blocks until a frame arrives; ``timeout=0`` polls
+        without blocking; any other value waits up to that many
+        seconds."""
         try:
-            return self._queue.get(timeout=timeout) if timeout \
-                else self._queue.get_nowait()
+            if timeout == 0:
+                return self._queue.get_nowait()
+            return self._queue.get(timeout=timeout)
         except queue.Empty:
             return None
 

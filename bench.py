@@ -678,9 +678,9 @@ def bench_pipeline_e2e() -> dict:
     pump(E2E_FRAMES)
     runtime.run(until=lambda: drain(E2E_FRAMES), timeout=600.0)
     elapsed = time.perf_counter() - start
-    runtime.terminate()
     okay_count = sum(1 for _, okay in collected if okay)
     if not collected or okay_count < len(collected):
+        runtime.terminate()
         return {"pipeline_e2e_error":
                 f"{okay_count}/{len(collected)} frames ok"}
 
@@ -689,7 +689,7 @@ def bench_pipeline_e2e() -> dict:
                         for metrics, _ in collected)
         return values[len(values) // 2]
 
-    return {
+    result = {
         "pipeline_e2e_fps": round(len(collected) / elapsed, 2),
         "pipeline_e2e_frames": len(collected),
         "pipeline_e2e_p50_ms": round(p50("time_pipeline") * 1000, 1),
@@ -697,6 +697,48 @@ def bench_pipeline_e2e() -> dict:
         "pipeline_e2e_p50_caption_ms": round(p50("CAP_time") * 1000, 2),
         "pipeline_e2e_p50_llm_ms": round(p50("LLM_time") * 1000, 1),
     }
+
+    # -- tunnel-insensitive variant (VERDICT r3 item 8): the SAME engine
+    # path, but frames reference a pre-uploaded ring of device-resident
+    # images -- no per-frame 1.2 MB host->device upload riding the
+    # tunnel -- and all frames are pumped at once so the async stages
+    # (park/resume Detector + cross-frame-batching LLM) overlap.  The
+    # residual per-frame cost is the engine walk + the small
+    # boxes/text fetches; this is the number that exposes the
+    # FRAMEWORK's own overhead rather than the tunnel's.
+    import jax
+    import jax.numpy as jnp
+    ring = [jax.device_put(jnp.asarray(
+        rng.integers(0, 255, (640, 640, 3), dtype=np.uint8)))
+        for _ in range(8)]
+    jax.block_until_ready(ring)
+    collected.clear()
+
+    def pump_device(count):
+        for i in range(count):
+            pipeline.process_frame_local({"image": ring[i % len(ring)]},
+                                         stream_id="bench_e2e",
+                                         queue_response=responses)
+
+    pump_device(E2E_WARMUP)
+    runtime.run(until=lambda: drain(E2E_WARMUP), timeout=600.0)
+    collected.clear()
+    start = time.perf_counter()
+    pump_device(E2E_FRAMES)
+    runtime.run(until=lambda: drain(E2E_FRAMES), timeout=600.0)
+    elapsed = time.perf_counter() - start
+    runtime.terminate()
+    okay_count = sum(1 for _, okay in collected if okay)
+    if collected and okay_count == len(collected):
+        result.update({
+            "pipeline_e2e_device_fps": round(
+                len(collected) / elapsed, 2),
+            "pipeline_e2e_device_p50_ms": round(
+                p50("time_pipeline") * 1000, 1)})
+    else:
+        result["pipeline_e2e_device_error"] = \
+            f"{okay_count}/{len(collected)} frames ok"
+    return result
 
 
 # ---------------------------------------------------------------------------

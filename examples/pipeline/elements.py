@@ -62,3 +62,22 @@ class Print(PipelineElement):
     def process_frame(self, stream, **inputs):
         print(f"frame: {inputs}")
         return StreamEvent.OKAY, dict(inputs)
+
+
+class Identity(PipelineElement):
+    """Pass-through entry element: each named graph path gets its own
+    head (path selection is by head name -- Stream.graph_path)."""
+
+    def process_frame(self, stream, **inputs):
+        return StreamEvent.OKAY, dict(inputs)
+
+
+class Select(PipelineElement):
+    """Multi-path sink: first non-None of its optional inputs becomes
+    ``result`` (paths write different swag keys; one sink serves all)."""
+
+    def process_frame(self, stream, y=None, z=None, x=None, **inputs):
+        for value in (y, z, x):
+            if value is not None:
+                return StreamEvent.OKAY, {"result": value}
+        return StreamEvent.OKAY, {"result": None}

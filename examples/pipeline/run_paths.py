@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Multi-path pipeline: one definition, three named graph paths; each
+stream runs exactly one path, selected by head name (reference:
+aiko_pipeline create pipeline_paths.json -s 1 -gp PE_IN_1).
+
+    python examples/pipeline/run_paths.py
+"""
+
+import os
+import queue
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+from aiko_services_tpu.pipeline import create_pipeline
+from aiko_services_tpu.runtime import init_process
+
+
+def main():
+    os.chdir(os.path.join(os.path.dirname(__file__), "..", ".."))
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    pipeline = create_pipeline("examples/pipeline/pipeline_paths.json",
+                               runtime=runtime)
+    for path, x in (("in_double", 6), ("in_square", 6), ("in_pass", 6)):
+        responses = queue.Queue()
+        pipeline.create_stream_local(path, graph_path=path,
+                                     queue_response=responses)
+        pipeline.process_frame_local({"x": x}, stream_id=path)
+        runtime.run(until=lambda: not responses.empty(), timeout=10.0)
+        _, _, swag, _, okay, diagnostic = responses.get()
+        assert okay, diagnostic
+        print(f"path {path}: x={x} -> result={swag['result']}")
+    runtime.terminate()
+
+
+if __name__ == "__main__":
+    main()

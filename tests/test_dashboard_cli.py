@@ -145,3 +145,45 @@ def test_dashboard_plugin_registry_and_registrar_view(runtime):
     finally:
         _PLUGINS.pop("worker_b", None)
     model.terminate()
+
+
+def test_dashboard_kill_and_copy_actions(runtime):
+    """Service-kill and copy-topic dashboard actions (reference
+    dashboard.py:399-408 _kill_service, :519-520 clipboard copy),
+    model-level with injected kill/copier."""
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    worker = Worker("worker_k", runtime=runtime)
+    model = DashboardModel(runtime)
+    assert run_until(
+        runtime,
+        lambda: any(r.name == "worker_k" for r in model.services()),
+        timeout=5.0)
+
+    killed, copied = [], []
+    # Nothing selected: both actions are no-ops.
+    assert model.kill_selected(kill=lambda *a: killed.append(a)) is False
+    assert model.copy_selected_topic(copier=copied.append) is None
+
+    model.select(worker.topic_path)
+    assert model.copy_selected_topic(copier=copied.append) \
+        == (worker.topic_path, True)
+    assert copied == [worker.topic_path]
+
+    # The worker lives in THIS process: killing it would kill the
+    # dashboard itself, which the guard refuses.
+    assert model.kill_selected(kill=lambda *a: killed.append(a)) is False
+    assert killed == []
+
+    # A same-host service in another process parses and kills.
+    import signal
+    parts = worker.topic_path.split("/")
+    other = "/".join(parts[:-2] + [str(int(parts[-2]) + 1), "1"])
+    model.selected = other
+    assert model.kill_selected(kill=lambda *a: killed.append(a)) is True
+    assert killed == [(int(parts[-2]) + 1, signal.SIGKILL)]
+
+    # A service on another host refuses (the reference's documented
+    # same-system limitation, made explicit).
+    model.selected = f"{parts[0]}/elsewhere/12345/1"
+    assert model.kill_selected(kill=lambda *a: killed.append(a)) is False
+    assert len(killed) == 1

@@ -54,6 +54,7 @@ def test_aloha_example(script, expected):
 
 @pytest.mark.parametrize("script,expected", [
     ("pipeline/run_local.py", "result="),
+    ("pipeline/run_paths.py", "path in_square: x=6 -> result=36"),
     ("pipeline/run_remote.py", "worker added 100"),
     ("detector/detect_image.py", "detections:"),
     ("llm/chat.py", "DONE"),

@@ -1,0 +1,86 @@
+"""Multi-path pipeline graphs: one definition, several named entry
+paths; a stream runs exactly ONE path, selected by head name
+(``Stream.graph_path`` / the wire ``create_stream`` params' graph_path
+-- reference pipeline_paths.json + pipeline.py:641)."""
+
+import pathlib
+import queue
+
+from conftest import run_until
+
+from aiko_services_tpu.pipeline import create_pipeline
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _paths_pipeline(runtime, monkeypatch):
+    monkeypatch.chdir(REPO)   # element modules are repo-root relative
+    return create_pipeline("examples/pipeline/pipeline_paths.json",
+                           runtime=runtime)
+
+
+def _run_path(pipeline, runtime, graph_path, x):
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local(graph_path,
+                                          graph_path=graph_path,
+                                          queue_response=responses)
+    assert stream is not None
+    pipeline.process_frame_local({"x": x}, stream_id=graph_path)
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    return swag
+
+
+def test_each_path_runs_only_its_elements(runtime, monkeypatch):
+    pipeline = _paths_pipeline(runtime, monkeypatch)
+    double = _run_path(pipeline, runtime, "in_double", 6)
+    square = _run_path(pipeline, runtime, "in_square", 6)
+    passthrough = _run_path(pipeline, runtime, "in_pass", 6)
+
+    assert double["result"] == 12
+    assert square["result"] == 36
+    assert passthrough["result"] == 6
+    # Only the selected path's elements executed: the double path never
+    # produced a square output and vice versa.
+    assert "z" not in double and "y" not in square
+    assert "y" not in passthrough and "z" not in passthrough
+    pipeline.stop()
+
+
+def test_wire_create_stream_selects_path(runtime, monkeypatch):
+    """The wire command's params dict carries graph_path (reference
+    create_stream(graph_path=...))."""
+    pipeline = _paths_pipeline(runtime, monkeypatch)
+    responses = queue.Queue()
+    pipeline.create_stream("wire", {"graph_path": "in_square"})
+    stream = pipeline.streams["wire"]
+    assert stream.graph_path == "in_square"
+    stream.queue_response = responses
+    pipeline.process_frame_local({"x": 5}, stream_id="wire")
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    assert swag["result"] == 25
+    pipeline.stop()
+
+
+def test_unknown_graph_path_rejected(runtime, monkeypatch):
+    pipeline = _paths_pipeline(runtime, monkeypatch)
+    assert pipeline.create_stream_local(
+        "bad", graph_path="no_such_head") is None
+    assert "bad" not in pipeline.streams
+    pipeline.stop()
+
+
+def test_default_path_is_first_head(runtime, monkeypatch):
+    pipeline = _paths_pipeline(runtime, monkeypatch)
+    # No graph_path: the first declared head's path runs (in_double).
+    responses = queue.Queue()
+    pipeline.create_stream_local("dflt", queue_response=responses)
+    pipeline.process_frame_local({"x": 4}, stream_id="dflt")
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    assert swag["result"] == 8
+    pipeline.stop()

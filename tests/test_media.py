@@ -266,3 +266,48 @@ def test_tty_read_write(tmp_path, runtime):
     assert run_until(
         runtime, lambda: output.getvalue().count("\n") >= 2, timeout=10.0)
     assert output.getvalue() == "alpha\nbeta\n"
+
+
+def test_audio_graph_xy():
+    """AudioGraphXY renders the spectrum into an image array (reference
+    PE_GraphXY parity, display-free): a tone's peak column draws a
+    full-height bar, quiet columns stay near the baseline."""
+    from aiko_services_tpu.elements.audio import AudioGraphXY
+    from aiko_services_tpu.pipeline.element import ElementContext
+
+    graph = AudioGraphXY(ElementContext(
+        "g", None, _FakePipeline(), {"width": 128, "height": 64}))
+    bins = 256
+    spectrum = np.full((2, bins), 0.01, dtype=np.float32)
+    spectrum[:, 64] = 1.0                       # peak at bin 64 -> col 32
+    event, outputs = graph.process_frame(None, spectrum=spectrum,
+                                         sample_rate=8000)
+    image = outputs["image"]
+    assert image.shape == (64, 128, 3) and image.dtype == np.uint8
+    assert outputs["spectrum"] is spectrum      # passthrough
+    bar_color = np.array([64, 200, 120], dtype=np.uint8)
+    bar_rows = (image == bar_color).all(axis=-1).sum(axis=0)  # per column
+    peak_col = int(bar_rows.argmax())
+    assert abs(peak_col - 32) <= 1              # peak lands where it should
+    assert bar_rows[peak_col] >= 60             # ~full height
+    assert np.median(bar_rows) <= 3             # quiet floor stays low
+
+
+def test_audio_graph_xy_max_frequency():
+    from aiko_services_tpu.elements.audio import AudioGraphXY
+    from aiko_services_tpu.pipeline.element import ElementContext
+
+    graph = AudioGraphXY(ElementContext(
+        "g", None, _FakePipeline(),
+        {"width": 64, "height": 32, "max_frequency": 2000}))
+    bins = 256                                  # nyquist 4 kHz at 8 kHz
+    spectrum = np.full((bins,), 0.01, dtype=np.float32)
+    spectrum[32] = 1.0                          # 0.5 kHz
+    event, outputs = graph.process_frame(None, spectrum=spectrum,
+                                         sample_rate=8000)
+    image = outputs["image"]
+    bar_color = np.array([64, 200, 120], dtype=np.uint8)
+    bar_rows = (image == bar_color).all(axis=-1).sum(axis=0)
+    # x axis now spans 0..2 kHz over 128 kept bins: the 0.5 kHz peak
+    # lands at ~1/4 of the width instead of 1/8.
+    assert abs(int(bar_rows.argmax()) - 16) <= 1

@@ -131,3 +131,51 @@ def test_pipeline_hop_over_tensor_scheme(runtime):
     np.testing.assert_array_equal(received, np.asarray(payload))
     consumer.destroy_stream("rx")
     producer.destroy_stream("tx")
+
+
+def test_hostname_resolution():
+    """tensor://localhost works: names resolve Python-side before the
+    numeric-IPv4-only C library sees them (ADVICE r3)."""
+    with TensorPipeServer(host="localhost") as server:
+        with TensorPipeClient("localhost", server.port) as client:
+            client.send(np.asarray([42], np.int32), name="dns")
+            name, got = server.recv(timeout=5.0)
+            assert name == "dns" and int(got[0]) == 42
+
+
+def test_unresolvable_host_diagnostic():
+    try:
+        TensorPipeClient("no-such-host.invalid", 1)
+        raised = False
+    except ConnectionError as error:
+        raised = "resolve" in str(error)
+    assert raised
+
+
+def test_recv_timeout_semantics():
+    """timeout=0 polls without blocking; timeout=None blocks (bounded
+    here by sending first)."""
+    with TensorPipeServer() as server:
+        assert server.recv(timeout=0) is None      # empty: instant None
+        with TensorPipeClient("127.0.0.1", server.port) as client:
+            client.send(np.asarray([7], np.int32))
+            name, got = server.recv()              # blocks until frame
+            assert int(got[0]) == 7
+
+
+def test_oversized_payload_drops_connection():
+    """A frame advertising more than max_payload drops the CONNECTION
+    before any allocation (ADVICE r3: cap peer-driven allocations);
+    a fresh connection still works."""
+    with TensorPipeServer(max_payload=1024) as server:
+        with TensorPipeClient("127.0.0.1", server.port) as client:
+            try:
+                client.send(np.zeros(4096, np.uint8))  # 4 KB > 1 KB cap
+            except ConnectionError:
+                pass    # server RSTs mid-send once it sees the advert:
+                        # a legitimate outcome of the drop policy
+            assert server.recv(timeout=0.5) is None
+        with TensorPipeClient("127.0.0.1", server.port) as client:
+            client.send(np.zeros(16, np.uint8), name="ok")
+            frame = server.recv(timeout=5.0)
+            assert frame is not None and frame[0] == "ok"
