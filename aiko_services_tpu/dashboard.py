@@ -211,7 +211,14 @@ class DashboardModel:
             return False              # the dashboard's own process
         import os
         import signal
-        (kill or os.kill)(pid, signal.SIGKILL)
+        try:
+            (kill or os.kill)(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError) as error:
+            # Stale registrar entry (process already gone) or a
+            # recycled pid owned by someone else: report, don't crash
+            # the dashboard.
+            _logger.warning("kill_selected: pid %s: %s", pid, error)
+            return False
         return True
 
     def copy_selected_topic(self, copier=None) -> tuple[str, bool] | None:

@@ -54,16 +54,17 @@ class LlamaConfig:
     # ops/pallas_decode.py -- streams the cache once, softmax stats in
     # VMEM, int8 cache dequantized in-kernel), or "auto" (flash once the
     # cache extent reaches ``flash_decode_threshold`` -- resolved at
-    # trace time, the cache length is static under jit).  The dense
-    # path's [B, H, T] HBM intermediates cost more than the cache
-    # itself at long context (BENCH_r03: 0.44 HBM util at 8k vs 0.78 at
-    # 1k); short contexts keep dense, whose single fused dispatch has
-    # less per-call overhead.  NOTE: pallas_call has no GSPMD
+    # trace time, the cache length is static under jit).  Measured on
+    # v5e with the flat cache: flash wins from 1k up (0.88 vs 0.86 HBM
+    # util at 1k; 0.84 vs ~0.45 at 8k, where dense's [B, H, T] HBM
+    # intermediates outweigh the cache); sub-1k test shapes keep dense
+    # (single fused dispatch, no interpret-mode kernel in CPU tests).
+    # NOTE: pallas_call has no GSPMD
     # partitioning rules, so under a tp-sharded cache keep "dense" (or
     # shard_map the layer); single-chip and dp-sharded serving -- the
     # benched configs -- compose fine.
     decode_attention: str = "auto"
-    flash_decode_threshold: int = 4096
+    flash_decode_threshold: int = 1024
     # KV cache storage: "bfloat16" or "int8" (per-token-per-head scales,
     # models/quant.py:quantize_kv).  Decode streams the whole cache every
     # step, so at long context the cache -- not the weights -- dominates
@@ -239,43 +240,58 @@ def partition_specs(config: LlamaConfig) -> dict:
 
 
 def cache_specs(config: LlamaConfig | None = None) -> dict:
-    """KV cache: batch over dp, kv heads over tp.  For an int8 cache
-    (config.kv_dtype) the spec tree mirrors the quantized layer
-    structure; the scale ([L, B, T, K, 1]) shards identically -- its
-    kv-head axis lives on the same chips as the payload's."""
-    spec = P(None, "dp", None, "tp", None)
+    """KV cache: batch over dp, kv heads over tp.  The FLAT payload
+    ([L, B, T, K*hd] -- see init_cache) shards its fused head axis over
+    tp (tp divides K, so contiguous C blocks map to whole kv heads);
+    an int8 cache's scale ([L, B, T, K, 1]) shards its kv-head axis on
+    the same chips."""
+    spec = P(None, "dp", None, "tp")
     if config is not None and config.kv_dtype == "int8":
-        leaf = {"int8": spec, "scale": spec}
+        leaf = {"int8": spec, "scale": P(None, "dp", None, "tp", None)}
         return {"k": leaf, "v": leaf}
     return {"k": spec, "v": spec}
 
 
 def init_cache(config: LlamaConfig, batch: int,
                max_seq: int | None = None) -> dict:
+    """Payloads are stored FLAT: [L, B, T, K*hd], the contiguous view
+    every consumer wants -- the dense einsums flatten to it anyway
+    (attention_decode_append's docstring) and the flash-decode Pallas
+    kernel REQUIRES the default layout on it: a grouped 5-D buffer
+    lets XLA pick a T-minor layout for the scatter writes and then
+    pay two full-cache layout-conversion copies per decode step in
+    front of the kernel (seen in compiled HLO on v5e).  Attention
+    consumers regroup to [.., T, K, hd] with :func:`_grouped` -- a
+    reshape of contiguous minor dims that fuses into the consuming
+    einsum.  int8 scales keep the grouped [L, B, T, K, 1] shape."""
     c = config
     t = max_seq or c.max_seq
-    shape = (c.n_layers, batch, t, c.n_kv_heads, c.head_dim)
+    shape = (c.n_layers, batch, t, c.n_kv_heads * c.head_dim)
     if c.kv_dtype == "int8":
         def layer():
             return {"int8": jnp.zeros(shape, dtype=jnp.int8),
-                    "scale": jnp.zeros(shape[:-1] + (1,),
-                                       dtype=jnp.float32)}
+                    "scale": jnp.zeros(
+                        shape[:-1] + (c.n_kv_heads, 1),
+                        dtype=jnp.float32)}
         return {"k": layer(), "v": layer()}
     return {"k": jnp.zeros(shape, dtype=_dtype(c)),
             "v": jnp.zeros(shape, dtype=_dtype(c))}
 
 
 def _kv_store(layer, new, write):
-    """Write raw k/v values ``new`` into a cache layer via
-    ``write(old_array, new_array) -> updated`` -- quantizing first when
-    the layer is an int8 cache leaf (the same positional write then
-    applies to the payload and to the scale, whose trailing axis is
-    size 1)."""
+    """Write raw k/v values ``new`` ([.., S, K, hd], grouped) into a
+    cache layer via ``write(old_array, new_array) -> updated`` --
+    payloads are written FLAT ([.., S, K*hd], matching the cache
+    storage); int8 layers quantize first, the scale keeping its
+    grouped shape.  ``write`` closures must therefore be rank-generic
+    (payload and scale differ by one trailing dim)."""
+    flat = new.reshape(*new.shape[:-2], -1)
     if is_quantized(layer):
         q = quantize_kv(new)
-        return {"int8": write(layer["int8"], q["int8"]),
+        return {"int8": write(layer["int8"],
+                              q["int8"].reshape(flat.shape)),
                 "scale": write(layer["scale"], q["scale"])}
-    return write(layer, new)
+    return write(layer, flat)
 
 
 def _kv_rows(layer, slice_fn):
@@ -284,6 +300,17 @@ def _kv_rows(layer, slice_fn):
         return {"int8": slice_fn(layer["int8"]),
                 "scale": slice_fn(layer["scale"])}
     return slice_fn(layer)
+
+
+def _grouped(layer, kv: int):
+    """Flat cache layer [.., T, K*hd] -> grouped [.., T, K, hd] view
+    for the attention einsums (contiguous-minor reshape: fuses into the
+    consuming dot, no copy; int8 scales are already grouped)."""
+    def regroup(arr):
+        return arr.reshape(*arr.shape[:-1], kv, arr.shape[-1] // kv)
+    if is_quantized(layer):
+        return {"int8": regroup(layer["int8"]), "scale": layer["scale"]}
+    return regroup(layer)
 
 
 def cache_array(cache: dict):
@@ -439,12 +466,20 @@ def _forward_layers(params: dict, config: LlamaConfig, hidden,
     (hidden, aux), updates = jax.lax.scan(
         layer_step, (hidden, jnp.float32(0.0)),
         (params["layers"], cache["k"], cache["v"]))
-    hidden = rms_norm(hidden, params["final_norm"], config.norm_eps)
-    logits = matmul(hidden, params["unembed"])
+    logits = _finish(params, config, hidden)
     if cache_from_updates is not None:
         return logits, cache_from_updates(updates), aux
     k_new, v_new = updates
     return logits, {"k": k_new, "v": v_new}, aux
+
+
+def _finish(params: dict, config: LlamaConfig, hidden) -> jax.Array:
+    """Final norm + unembed, shared by _forward_layers and the flash
+    decode scan (which carries a layer INDEX instead of cache slices --
+    keep the two scaffolds in sync through this helper; decode never
+    differentiates, so config.remat is irrelevant there)."""
+    hidden = rms_norm(hidden, params["final_norm"], config.norm_eps)
+    return matmul(hidden, params["unembed"])
 
 
 def _prefill_core(params: dict, config: LlamaConfig, tokens: jax.Array,
@@ -467,9 +502,11 @@ def _prefill_core(params: dict, config: LlamaConfig, tokens: jax.Array,
             k_layer2 = _kv_store(k_layer, k, write)
             v_layer2 = _kv_store(v_layer, v, write)
             kv_write.updated = (k_layer2, v_layer2)
-            # Grouped cache consumed directly (attention_prefill groups
+            # Grouped view consumed directly (attention_prefill groups
             # the queries): no repeat_kv materialization.
-            return attention_prefill(q, k_layer2, v_layer2, positions)
+            return attention_prefill(q, _grouped(k_layer2, c.n_kv_heads),
+                                     _grouped(v_layer2, c.n_kv_heads),
+                                     positions)
         return kv_write
 
     return _forward_layers(params, c, params["embed"][tokens], cache,
@@ -530,16 +567,17 @@ def prefill_into_slot(params: dict, config: LlamaConfig,
 
             def write(old, new):
                 return jax.lax.dynamic_update_slice(
-                    old, new, (slot, start, 0, 0))
+                    old, new, (slot, start) + (0,) * (old.ndim - 2))
 
             def row(arr):
                 return jax.lax.dynamic_slice(
-                    arr, (slot, 0, 0, 0), (1,) + arr.shape[1:])
+                    arr, (slot,) + (0,) * (arr.ndim - 1),
+                    (1,) + arr.shape[1:])
             k_layer2 = _kv_store(k_layer, k, write)
             v_layer2 = _kv_store(v_layer, v, write)
             kv_write.updated = (k_layer2, v_layer2)
-            k_row = _kv_rows(k_layer2, row)
-            v_row = _kv_rows(v_layer2, row)
+            k_row = _grouped(_kv_rows(k_layer2, row), c.n_kv_heads)
+            v_row = _grouped(_kv_rows(v_layer2, row), c.n_kv_heads)
             if c.attention == "flash":
                 # Causality from the traced chunk offset covers both
                 # intra-chunk masking and the unwritten cache tail.
@@ -596,19 +634,21 @@ def prefill_into_slots(params: dict, config: LlamaConfig,
                 # decode_step).
                 for i in range(n):
                     old = jax.lax.dynamic_update_slice(
-                        old, new[i:i + 1], (slots[i], starts[i], 0, 0))
+                        old, new[i:i + 1],
+                        (slots[i], starts[i]) + (0,) * (old.ndim - 2))
                 return old
 
             def gather_rows(arr):
                 return jnp.concatenate(
-                    [jax.lax.dynamic_slice(arr, (slots[i], 0, 0, 0),
-                                           (1,) + arr.shape[1:])
-                     for i in range(n)])                     # [N,T,K,*]
+                    [jax.lax.dynamic_slice(
+                        arr, (slots[i],) + (0,) * (arr.ndim - 1),
+                        (1,) + arr.shape[1:])
+                     for i in range(n)])                     # [N,T,*]
             k_l = _kv_store(k_layer, k, write_rows)
             v_l = _kv_store(v_layer, v, write_rows)
             kv_write.updated = (k_l, v_l)
-            k_rows = _kv_rows(k_l, gather_rows)
-            v_rows = _kv_rows(v_l, gather_rows)
+            k_rows = _grouped(_kv_rows(k_l, gather_rows), c.n_kv_heads)
+            v_rows = _grouped(_kv_rows(v_l, gather_rows), c.n_kv_heads)
             return attention_prefill(q, k_rows, v_rows, positions)
         return kv_write
 
@@ -631,9 +671,13 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     positions = lengths[:, None]                       # [B, 1]
     cache_extent = cache_array(cache).shape[2]
+    # The stacked kernel needs a block-aligned cache extent (it never
+    # pads -- padding a stacked cache would copy it); "auto" quietly
+    # keeps dense for exotic extents, explicit "flash" raises there.
     use_flash = c.decode_attention == "flash" or (
         c.decode_attention == "auto"
-        and cache_extent >= c.flash_decode_threshold)
+        and cache_extent >= c.flash_decode_threshold
+        and cache_extent % 128 == 0)
 
     def factory(k_layer, v_layer):
         def kv_write(q, k, v):
@@ -643,15 +687,9 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
             # k/v leave the scan (see _forward_layers / the post-scan
             # scatter below).
             kv_write.updated = (k, v)
-            if use_flash:
-                # Split-K Pallas kernel: cache streamed once, no
-                # [B, H, T] HBM intermediates, int8 dequantized
-                # in-kernel (ops/pallas_decode.py).
-                from ..ops.pallas_decode import flash_decode_append
-                return flash_decode_append(q, k_layer, v_layer, k, v,
-                                           lengths)
-            return attention_decode_append(q, k_layer, v_layer, k, v,
-                                           lengths)
+            return attention_decode_append(
+                q, _grouped(k_layer, c.n_kv_heads),
+                _grouped(v_layer, c.n_kv_heads), k, v, lengths)
         return kv_write
 
     def scatter_tokens(updates):
@@ -669,11 +707,48 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
                 for row in range(b):
                     old = jax.lax.dynamic_update_slice(
                         old, new[:, row][:, None],
-                        (0, row, lengths[row], 0, 0))
+                        (0, row, lengths[row]) + (0,) * (old.ndim - 3))
                 return old
             return _kv_store(layer, tokens, write)
         return {"k": scatter(cache["k"], k_tokens),
                 "v": scatter(cache["v"], v_tokens)}
+
+    if use_flash:
+        # Split-K Pallas kernel path (ops/pallas_decode.py): the cache
+        # streams once, no [B, H, T] HBM intermediates, int8 dequantized
+        # in-kernel.  The layer scan carries the LAYER INDEX and the
+        # kernel indexes the STACKED FLAT cache in its BlockSpecs --
+        # putting the cache in scan xs would materialize a per-layer
+        # slice copy ahead of the pallas call (XLA fuses slices into
+        # einsums but not into custom calls; measured ~0.3 ms/layer at
+        # 8k on v5e).  The flat [L, B, T, K*hd] storage (init_cache) is
+        # what keeps the kernel's operand at the default layout -- see
+        # its docstring for the 2x full-cache copies a grouped buffer
+        # cost.
+        from ..ops.pallas_decode import (_split_stacked,
+                                         flash_decode_append_stacked)
+        k_view = _split_stacked(cache["k"])
+        v_view = _split_stacked(cache["v"])
+        hidden0 = params["embed"][tokens][:, None, :]
+
+        def layer_step(carry, xs):
+            hidden, aux = carry
+            layer, index = xs
+
+            def kv_write(q, k, v):
+                q = apply_rope(q, rope_table, positions)
+                k = apply_rope(k, rope_table, positions)
+                kv_write.updated = (k, v)
+                return flash_decode_append_stacked(
+                    q, k_view, v_view, index, k, v, lengths)
+            hidden2, aux2 = _block(c, hidden, layer, kv_write)
+            return (hidden2, aux + aux2), kv_write.updated
+
+        (hidden, _), updates = jax.lax.scan(
+            layer_step, (hidden0, jnp.float32(0.0)),
+            (params["layers"], jnp.arange(c.n_layers)))
+        return _finish(params, c, hidden)[:, 0, :], \
+            scatter_tokens(updates)
 
     logits, new_cache, _ = _forward_layers(
         params, c, params["embed"][tokens][:, None, :], cache, factory,

@@ -139,10 +139,11 @@ def _round_up(n, multiple):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "causal", "block_q", "block_k", "interpret"))
+    "causal", "block_q", "block_k", "interpret", "pack_heads"))
 def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
                     block_q: int = 512, block_k: int = 1024,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    pack_heads: bool = False):
     """Causal flash attention.
 
     q: [B, S, H, d]; k/v: [B, T, Hkv, d] with H % Hkv == 0 (GQA: each
@@ -156,17 +157,28 @@ def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
     rounding; see _online_update).
 
     Default blocks (512 x 1024) are tuned on v5e at head_dim 64 / 8k
-    context: ~34% of chip peak on the fully-live causal region (vs 16%
-    for the round-2 kernel).  The d=64 contraction halves the MXU feed,
-    so the ceiling is ~50%; the rest of the gap was VPU softmax work,
-    cut by the interior/boundary split (most blocks skip masking
+    context: ~41% of chip peak on the fully-live causal region on an
+    uncontended run (the round-3 29.9% record carried tunnel noise; the
+    round-2 kernel measured ~16%).  The non-matmul gap is VPU softmax
+    work, cut by the interior/boundary split (most blocks skip masking
     entirely), the bf16 exp, and folding the scale into q.
+
+    ``pack_heads`` pairs two kv heads per grid row with block-diagonal
+    queries, filling the 128-wide MXU dimension that a d=64 contraction
+    leaves half-idle in BOTH kernel matmuls.  MEASURED on v5e: slightly
+    SLOWER than unpacked (37.7% vs 40.9% of peak, same methodology) --
+    the MXU pipelines 64-deep contractions without stalling, so packing
+    only adds output-width traffic.  Kept as an option because the
+    arithmetic is exact (tested) and other TPU generations may trade
+    differently.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, s, h, d = q.shape
     t, h_kv = k.shape[1], k.shape[2]
     groups = h // h_kv
+    if pack_heads and (h_kv % 2 or d > 64):
+        pack_heads = False            # needs paired kv heads, d <= 64
 
     # Blocks clamp to the (padded) sequence but stay sublane-aligned.
     block_q = min(block_q, _round_up(max(s, 8), 8))
@@ -179,12 +191,41 @@ def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
     # HBM traffic, which dominates long-context prefill.
     rows_per_head = _round_up(max(s, 8), block_q)
     q4 = _pad_to(q.transpose(0, 2, 1, 3), 2, rows_per_head)  # [B,H,S',d]
-    q_r = q4.reshape(b * h_kv, groups * rows_per_head, d)
-    k_r = _pad_to(k.transpose(0, 2, 1, 3).reshape(b * h_kv, t, d),
-                  1, block_k)
-    v_r = _pad_to(v.transpose(0, 2, 1, 3).reshape(b * h_kv, t, d),
-                  1, block_k)
+    if pack_heads:
+        # Cross-head packing at head_dim 64: both kernel matmuls leave
+        # half the 128-wide MXU dimension idle (QK contracts over d=64;
+        # PV writes d=64-wide output).  Pack PAIRS of kv heads into one
+        # grid row: queries go block-diagonal ([q | 0] rows for the
+        # pair's first member, [0 | q] for the second) against the
+        # pair's keys/values concatenated along d ([k_a | k_b]) -- the
+        # zero halves kill the cross terms, the contraction becomes
+        # 2d = 128, PV's output width becomes 128, and the grid has
+        # half the rows at identical total DMA.  The kernel itself is
+        # unchanged: it just sees d' = 2d and twice the head blocks
+        # per row (rows_per_head periodicity still holds).
+        sp = q4.shape[2]
+        q6 = q4.reshape(b, h_kv // 2, 2, groups, sp, d)
+        member0 = jnp.pad(q6[:, :, 0], ((0, 0),) * 4 + ((0, d),))
+        member1 = jnp.pad(q6[:, :, 1], ((0, 0),) * 4 + ((d, 0),))
+        q_r = jnp.stack([member0, member1], axis=2).reshape(
+            b * (h_kv // 2), 2 * groups * sp, 2 * d)
+
+        def pack_kv(x):                           # [B,T,K,d] -> paired
+            x5 = x.transpose(0, 2, 1, 3).reshape(b, h_kv // 2, 2, t, d)
+            x5 = x5.transpose(0, 1, 3, 2, 4)      # [B,K/2,T,2,d]
+            return x5.reshape(b * (h_kv // 2), t, 2 * d)
+        k_r = _pad_to(pack_kv(k), 1, block_k)
+        v_r = _pad_to(pack_kv(v), 1, block_k)
+        grid_rows = b * (h_kv // 2)
+    else:
+        q_r = q4.reshape(b * h_kv, groups * rows_per_head, d)
+        k_r = _pad_to(k.transpose(0, 2, 1, 3).reshape(b * h_kv, t, d),
+                      1, block_k)
+        v_r = _pad_to(v.transpose(0, 2, 1, 3).reshape(b * h_kv, t, d),
+                      1, block_k)
+        grid_rows = b * h_kv
     rows_pad, t_pad = q_r.shape[1], k_r.shape[1]
+    d_kernel = q_r.shape[2]
 
     # Fold the softmax scale into q when that is LOSSLESS in q's dtype
     # (d**-0.5 an exact power of two, e.g. 1/8 at d = 64) -- saving a
@@ -195,7 +236,7 @@ def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
         q_r = (q_r.astype(jnp.float32) * scale).astype(q_r.dtype)
         scale = None
 
-    grid = (b * h_kv, rows_pad // block_q, t_pad // block_k)
+    grid = (grid_rows, rows_pad // block_q, t_pad // block_k)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k,
         causal=causal, kv_len=t, rows_per_head=rows_per_head,
@@ -216,26 +257,42 @@ def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d),
+            pl.BlockSpec((1, block_q, d_kernel),
                          lambda bh, qi, ki, offset: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), kv_block),
-            pl.BlockSpec((1, block_k, d), kv_block),
+            pl.BlockSpec((1, block_k, d_kernel), kv_block),
+            pl.BlockSpec((1, block_k, d_kernel), kv_block),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
+        out_specs=pl.BlockSpec((1, block_q, d_kernel),
                                lambda bh, qi, ki, offset: (bh, qi, 0)),
         scratch_shapes=[
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, d_kernel), jnp.float32),
         ],
     )
     offset = jnp.asarray([q_offset], dtype=jnp.int32)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h_kv, rows_pad, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((grid_rows, rows_pad, d_kernel),
+                                       q.dtype),
         interpret=interpret,
     )(offset, q_r, k_r, v_r)
+
+    if pack_heads:
+        # [B*K/2, 2*G*S', 2d]: member 0's rows hold their result in the
+        # first d lanes, member 1's in the last d (the other half is the
+        # partner head's weighted values -- discarded).  Selected with a
+        # broadcast where rather than stack-of-sliced-halves: the
+        # tunnel backend miscompiles that gather pattern (verified:
+        # pure data movement came back wrong), where-select round-trips
+        # exactly on every backend.
+        out = out.reshape(b, h_kv // 2, 2, groups, rows_per_head, 2 * d)
+        member = jax.lax.broadcasted_iota(jnp.int32, out.shape[:5] + (1,),
+                                          2)
+        out = jnp.where(member == 0, out[..., :d], out[..., d:])
+        out = out.reshape(b, h_kv, groups, rows_per_head, d)[:, :, :, :s]
+        return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
     # [B*Hkv, G*S', d] -> [B, Hkv, G, S', d] -> [B, S, H, d]
     # (head h = kv*G + g, matching the q reshape above).

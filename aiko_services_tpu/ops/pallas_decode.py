@@ -30,6 +30,8 @@ it is the MXU-friendly formulation):
 - blocks wholly beyond a row's ``length`` clamp their DMA index to the
   last live block (fetch skipped, compute skipped via pl.when), so
   short rows in a ragged batch do not pay full-T bandwidth;
+- block_t defaults to 2048: per-grid-step overhead dominates below that
+  (measured on v5e at 8k: 233 GB/s at 512, 367 at 1024, 410+ at 2048);
 - the kernel returns UNNORMALIZED (acc, m, l) partial softmax stats;
   the caller merges the current token's self-attention term outside
   (exactly the split the dense path uses) -- see
@@ -53,7 +55,8 @@ try:
 except ImportError:                               # pragma: no cover
     pltpu = None
 
-__all__ = ["flash_decode_attention", "flash_decode_append"]
+__all__ = ["flash_decode_attention", "flash_decode_append",
+           "flash_decode_attention_stacked", "flash_decode_append_stacked"]
 
 
 def is_quantized(leaf) -> bool:
@@ -78,15 +81,21 @@ def _group_onehot(h: int, n_kv: int, dtype, groups: int | None = None):
     return (rows == cols).astype(dtype)
 
 
-def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+def _decode_kernel(meta_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                    o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr, *,
                    block_t, n_heads, n_kv, groups, compute_dtype,
-                   quantized):
+                   quantized, layered):
+    """meta_ref: scalar-prefetch i32 array -- ``lengths`` [B] in the
+    per-layer form, ``[layer, *lengths]`` in the layered form (the cache
+    refs then carry a leading layer dim the BlockSpecs index into)."""
     b = pl.program_id(0)
     ti = pl.program_id(1)
     nt = pl.num_programs(1)
-    length = lengths_ref[b]
+    length = meta_ref[1 + b] if layered else meta_ref[b]
     t_start = ti * block_t
+
+    def kv_blk(ref):
+        return ref[0, 0] if layered else ref[0]
 
     @pl.when(ti == 0)
     def _init():
@@ -100,7 +109,7 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
     interior = t_start + block_t <= length
 
     def _scores():
-        k_blk = k_ref[0]
+        k_blk = kv_blk(k_ref)
         if quantized:
             k_blk = k_blk.astype(compute_dtype)
         s = jax.lax.dot_general(
@@ -114,7 +123,7 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
             onehot = _group_onehot(n_heads, n_kv, jnp.float32,
                                    groups=groups)
             s = s * jax.lax.dot_general(
-                onehot, ks_ref[0], (((1,), (0,)), ((), ())),
+                onehot, kv_blk(ks_ref), (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
         return s
 
@@ -133,7 +142,7 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
             l_prev * correction
             + jnp.sum(p, axis=1, keepdims=True, dtype=jnp.float32),
             l_scr.shape)
-        v_blk = v_ref[0]
+        v_blk = kv_blk(v_ref)
         if quantized:
             # Value scales fold into the weights -- exact for the same
             # constant-along-hd reason; the weights themselves stay
@@ -142,7 +151,7 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
             onehot = _group_onehot(n_heads, n_kv, jnp.float32,
                                    groups=groups)
             p = p * jax.lax.dot_general(
-                onehot, vs_ref[0], (((1,), (0,)), ((), ())),
+                onehot, kv_blk(vs_ref), (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             v_blk = v_blk.astype(compute_dtype)
         pv = jax.lax.dot_general(
@@ -186,7 +195,7 @@ def _round_up(n, multiple):
 
 @functools.partial(jax.jit, static_argnames=("block_t", "interpret"))
 def flash_decode_attention(q_pad, k_flat, v_flat, k_scale_t, v_scale_t,
-                           lengths, *, block_t: int = 512,
+                           lengths, *, block_t: int = 2048,
                            interpret: bool | None = None):
     """Split-K decode attention over the cache; returns partial stats.
 
@@ -245,7 +254,7 @@ def flash_decode_attention(q_pad, k_flat, v_flat, k_scale_t, v_scale_t,
     kernel = functools.partial(
         _decode_kernel, block_t=block_t, n_heads=h_pad, n_kv=n_kv,
         groups=max(h // n_kv, 1), compute_dtype=compute_dtype,
-        quantized=quantized)
+        quantized=quantized, layered=False)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -284,8 +293,172 @@ def flash_decode_attention(q_pad, k_flat, v_flat, k_scale_t, v_scale_t,
     return acc[:, :h], m[:, :h, 0], l[:, :h, 0]
 
 
+@functools.partial(jax.jit, static_argnames=("block_t", "interpret"))
+def flash_decode_attention_stacked(q_pad, k_flat, v_flat, k_scale_t,
+                                   v_scale_t, layer, lengths, *,
+                                   block_t: int = 2048,
+                                   interpret: bool | None = None):
+    """:func:`flash_decode_attention` over ONE layer of a STACKED cache.
+
+    k_flat/v_flat: [L, B, T, C] -- the whole layer-stacked cache, passed
+    scan-invariant; ``layer`` (traced scalar) selects which layer's
+    blocks the BlockSpecs DMA.  This exists because a per-layer cache
+    slice fed to ``pallas_call`` from inside the layer scan must
+    MATERIALIZE (XLA fuses dynamic-slices into einsums but not into
+    pallas calls, and the post-scan cache scatter keeps the stacked
+    buffer live) -- measured ~0.3 ms/layer of hidden copy traffic at 8k
+    on v5e, which erased the kernel's win.  Indexing the layer inside
+    the grid spec reads the cache in place.  k_scale_t/v_scale_t:
+    [L, B, K, T] f32 or None; lengths: [B].  T must be a multiple of
+    block_t (block_t is shrunk to a divisor by the caller -- padding a
+    stacked cache would copy it).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    quantized = k_scale_t is not None
+    b, h, c = q_pad.shape
+    t = k_flat.shape[2]
+    n_kv = k_scale_t.shape[2] if quantized else None
+
+    h_pad = _round_up(max(h, 8), 8)
+    q_pad = _pad_to(q_pad, 1, h_pad)
+    block_t = min(block_t, _round_up(max(t, 8), 8))
+    while t % block_t and block_t > 128:   # never pad a stacked cache
+        block_t //= 2
+    if t % block_t:
+        # Callers gate on t % 128 == 0 (llama decode_step falls back to
+        # dense); reaching here means an explicit misuse.
+        raise ValueError(
+            f"flash_decode_attention_stacked: cache extent {t} has no "
+            f"block-aligned divisor >= 128 (use a multiple of 128, or "
+            f"the dense/per-layer path)")
+    if not quantized:
+        n_kv = 1
+        k_scale_t = jnp.zeros((1, b, 1, t), dtype=jnp.float32)
+        v_scale_t = jnp.zeros((1, b, 1, t), dtype=jnp.float32)
+
+    grid = (b, t // block_t)
+    compute_dtype = q_pad.dtype
+    scale_layers = k_scale_t.shape[0]
+
+    def _clamped(bi, ti, meta):
+        last_live = jnp.maximum(pl.cdiv(meta[1 + bi], block_t) - 1, 0)
+        return jnp.minimum(ti, last_live)
+
+    def kv_block(bi, ti, meta):
+        return (meta[0], bi, _clamped(bi, ti, meta), 0)
+
+    def scale_block(bi, ti, meta):
+        # Unquantized caches pass a [1, B, 1, T] dummy: clamp the layer
+        # index so the spec never reads past it.
+        return (jnp.minimum(meta[0], scale_layers - 1), bi, 0,
+                _clamped(bi, ti, meta))
+
+    kernel = functools.partial(
+        _decode_kernel, block_t=block_t, n_heads=h_pad, n_kv=n_kv,
+        groups=max(h // n_kv, 1), compute_dtype=compute_dtype,
+        quantized=quantized, layered=True)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h_pad, c), lambda bi, ti, meta: (bi, 0, 0)),
+            pl.BlockSpec((1, 1, block_t, c), kv_block),
+            pl.BlockSpec((1, 1, block_t, c), kv_block),
+            pl.BlockSpec((1, 1, n_kv, block_t), scale_block),
+            pl.BlockSpec((1, 1, n_kv, block_t), scale_block),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h_pad, c), lambda bi, ti, meta: (bi, 0, 0)),
+            pl.BlockSpec((1, h_pad, _STAT_LANES),
+                         lambda bi, ti, meta: (bi, 0, 0)),
+            pl.BlockSpec((1, h_pad, _STAT_LANES),
+                         lambda bi, ti, meta: (bi, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h_pad, c), jnp.float32),
+        ],
+    )
+    meta = jnp.concatenate([
+        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
+        jnp.asarray(lengths, dtype=jnp.int32)])
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h_pad, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_pad, _STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_pad, _STAT_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(meta, q_pad, k_flat, v_flat, k_scale_t, v_scale_t)
+    return acc[:, :h], m[:, :h, 0], l[:, :h, 0]
+
+
+def _split_stacked(cache):
+    """Stacked cache tree -> ([L, B, T, C] payload, [L, B, K, T] f32
+    scales or None).  Payloads are stored flat already (llama
+    init_cache); a grouped [L, B, T, K, hd] payload is collapsed (a
+    contiguous-minor bitcast).  The scale transpose is a real copy, but
+    of the small f32 scales, once per step."""
+    if is_quantized(cache):
+        payload = cache["int8"]
+        scale = cache["scale"][..., 0].transpose(0, 1, 3, 2) \
+            .astype(jnp.float32)
+    else:
+        payload, scale = cache, None
+    if payload.ndim == 5:
+        n_layers, b, t, kv, d = payload.shape
+        payload = payload.reshape(n_layers, b, t, kv * d)
+    return payload, scale
+
+
+def _prep_query(q_flat, h: int, kv: int, d: int):
+    """Scaled block-diagonal queries + (blocks, onehot) head maps."""
+    scale = d ** -0.5
+    blocks = jnp.arange(h) // (h // kv)                   # [H] kv head
+    onehot = _group_onehot(h, kv, q_flat.dtype)           # [H, K]
+    # Fold the softmax scale into the padded queries -- lossless when
+    # d**-0.5 is a power of two (d = 64), otherwise folded in f32 and
+    # rounded once (same rounding the dense path's f32 product takes).
+    q_scaled = (q_flat.astype(jnp.float32) * scale).astype(q_flat.dtype) \
+        if math.log2(scale).is_integer() \
+        else (q_flat.astype(jnp.float32) * scale)
+    q_pad = jnp.einsum("bhd,hk->bhkd", q_scaled,
+                       onehot.astype(q_scaled.dtype)) \
+        .reshape(q_flat.shape[0], h, kv * d)
+    return q_pad, blocks, onehot, scale
+
+
+def _combine_self(acc, m, l, q_flat, k_new, v_new, blocks, onehot,
+                  scale, kv: int, d: int):
+    """Merge the current token's self-attention term with the kernel's
+    partial stats (exact two-part softmax combine, mirroring the dense
+    path's cache/self split).  Returns [B, H, hd] f32."""
+    b, h = q_flat.shape[:2]
+    k_new_h = k_new[:, 0][:, blocks, :]                   # [B, H, hd]
+    v_new_h = v_new[:, 0][:, blocks, :]
+    self_logits = (q_flat.astype(jnp.float32)
+                   * k_new_h.astype(jnp.float32)).sum(-1) * scale
+    m_joint = jnp.maximum(m, self_logits)
+    correction = jnp.where(m <= _NEG_INF / 2, 0.0,
+                           jnp.exp(m - m_joint))          # [B, H]
+    self_weight = jnp.exp(self_logits - m_joint)
+    denominator = l * correction + self_weight
+    # Select each head's own kv block out of the fused accumulator.
+    cache_part = jnp.einsum(
+        "bhkd,hk->bhd", acc.reshape(b, h, kv, d),
+        onehot.astype(jnp.float32))                       # [B, H, hd]
+    return (cache_part * correction[:, :, None]
+            + self_weight[:, :, None] * v_new_h.astype(jnp.float32)) \
+        / denominator[:, :, None]
+
+
 def flash_decode_append(q, k_cache, v_cache, k_new, v_new, lengths, *,
-                        block_t: int = 512,
+                        block_t: int = 2048,
                         interpret: bool | None = None):
     """Drop-in replacement for
     :func:`~aiko_services_tpu.ops.layers.attention_decode_append`
@@ -296,6 +469,10 @@ def flash_decode_append(q, k_cache, v_cache, k_new, v_new, lengths, *,
     dequantized IN KERNEL, see module docstring); k_new/v_new:
     [B, 1, K, hd] the current token's raw k/v (not yet written);
     lengths: [B] valid cache positions.  Returns [B, 1, H, hd].
+
+    Inside a layer scan whose stacked cache is later scatter-updated,
+    use :func:`flash_decode_append_stacked` instead -- feeding this
+    function a scan slice materializes a per-layer cache copy.
     """
     b, _, h, d = q.shape
     if is_quantized(k_cache):
@@ -313,40 +490,37 @@ def flash_decode_append(q, k_cache, v_cache, k_new, v_new, lengths, *,
     t, kv = k_payload.shape[1], k_payload.shape[2]
     c = kv * d
 
-    scale = d ** -0.5
-    blocks = jnp.arange(h) // (h // kv)                   # [H] kv head
-    onehot = _group_onehot(h, kv, q.dtype)                # [H, K]
     q_flat = q[:, 0]                                      # [B, H, hd]
-    # Fold the softmax scale into the padded queries -- lossless when
-    # d**-0.5 is a power of two (d = 64), otherwise folded in f32 and
-    # rounded once (same rounding the dense path's f32 product takes).
-    q_scaled = (q_flat.astype(jnp.float32) * scale).astype(q.dtype) \
-        if math.log2(scale).is_integer() \
-        else (q_flat.astype(jnp.float32) * scale)
-    q_pad = jnp.einsum("bhd,hk->bhkd", q_scaled,
-                       onehot.astype(q_scaled.dtype)).reshape(b, h, c)
-
+    q_pad, blocks, onehot, scale = _prep_query(q_flat, h, kv, d)
     acc, m, l = flash_decode_attention(
         q_pad, k_payload.reshape(b, t, c), v_payload.reshape(b, t, c),
         k_scale_t, v_scale_t, lengths,
         block_t=block_t, interpret=interpret)
+    out = _combine_self(acc, m, l, q_flat, k_new, v_new, blocks,
+                        onehot, scale, kv, d)
+    return out.reshape(q.shape).astype(q.dtype)
 
-    # Merge the current token's self-attention term (exact two-part
-    # softmax combine, mirroring the dense path's cache/self split).
-    k_new_h = k_new[:, 0][:, blocks, :]                   # [B, H, hd]
-    v_new_h = v_new[:, 0][:, blocks, :]
-    self_logits = (q_flat.astype(jnp.float32)
-                   * k_new_h.astype(jnp.float32)).sum(-1) * scale
-    m_joint = jnp.maximum(m, self_logits)
-    correction = jnp.where(m <= _NEG_INF / 2, 0.0,
-                           jnp.exp(m - m_joint))          # [B, H]
-    self_weight = jnp.exp(self_logits - m_joint)
-    denominator = l * correction + self_weight
-    # Select each head's own kv block out of the fused accumulator.
-    cache_part = jnp.einsum(
-        "bhkd,hk->bhd", acc.reshape(b, h, kv, d),
-        onehot.astype(jnp.float32))                       # [B, H, hd]
-    out = (cache_part * correction[:, :, None]
-           + self_weight[:, :, None] * v_new_h.astype(jnp.float32)) \
-        / denominator[:, :, None]
+
+def flash_decode_append_stacked(q, k_view, v_view, layer, k_new, v_new,
+                                lengths, *, block_t: int = 2048,
+                                interpret: bool | None = None):
+    """Layer-scan form of :func:`flash_decode_append`: the cache stays
+    STACKED and scan-invariant ([L, B, T, C] payload views +
+    [L, B, K, T] scales from :func:`_split_stacked`), and the traced
+    ``layer`` scalar picks the layer inside the kernel's BlockSpecs --
+    no per-layer slice buffer, no hidden cache copy (see
+    flash_decode_attention_stacked).  q/k_new/v_new/lengths as in
+    flash_decode_append."""
+    b, _, h, d = q.shape
+    k_payload, k_scale_t = k_view
+    v_payload, v_scale_t = v_view
+    kv = k_payload.shape[3] // d
+
+    q_flat = q[:, 0]
+    q_pad, blocks, onehot, scale = _prep_query(q_flat, h, kv, d)
+    acc, m, l = flash_decode_attention_stacked(
+        q_pad, k_payload, v_payload, k_scale_t, v_scale_t, layer,
+        lengths, block_t=block_t, interpret=interpret)
+    out = _combine_self(acc, m, l, q_flat, k_new, v_new, blocks,
+                        onehot, scale, kv, d)
     return out.reshape(q.shape).astype(q.dtype)

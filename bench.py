@@ -528,16 +528,35 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
                     return acc + out.astype(jnp.float32).sum()
                 return lax.fori_loop(0, fiters, body, jnp.float32(0.0))
 
-            float(flash_loop(fq, fk, fv))           # compile + warm
-            # Best of 2: the RTT subtraction's run-to-run variance on
-            # this tunnel can otherwise swing the figure by ~20%.
-            elapsed = min(time_device_loop(
-                lambda: float(flash_loop(fq, fk, fv)), rtt)
-                for _ in range(2))
             attended = sum(range(ft - fs + 1, ft + 1))
             fl = 4 * 32 * 64 * attended
-            result["flash_kernel_pct_peak"] = round(
-                fl * fiters / elapsed / peak * 100, 1)
+
+            @jax.jit
+            def flash_loop_packed(fq, fk, fv):
+                def body(i, acc):
+                    out = flash_attention(
+                        fq + (i * 1e-6).astype(fq.dtype), fk, fv,
+                        q_offset=ft - fs, pack_heads=True)
+                    return acc + out.astype(jnp.float32).sum()
+                return lax.fori_loop(0, fiters, body, jnp.float32(0.0))
+
+            # Best of 3: the RTT subtraction's run-to-run variance on
+            # this tunnel can otherwise swing the figure by ~20%.
+            for key, loop_fn in (
+                    ("flash_kernel_pct_peak", flash_loop),
+                    # VERDICT r3 item 5: the cross-head q-packing
+                    # variant (two query heads per 128-wide
+                    # contraction), measured -- on v5e it runs
+                    # SLIGHTLY SLOWER than the unpacked kernel (the
+                    # MXU pipelines 64-deep contractions; packing just
+                    # adds output-width traffic), so this key is the
+                    # recorded refutation, not the default path.
+                    ("flash_kernel_packed_pct_peak", flash_loop_packed)):
+                float(loop_fn(fq, fk, fv))          # compile + warm
+                elapsed = min(time_device_loop(
+                    lambda: float(loop_fn(fq, fk, fv)), rtt)
+                    for _ in range(3))
+                result[key] = round(fl * fiters / elapsed / peak * 100, 1)
         except Exception as error:
             result["flash_kernel_error"] = \
                 f"{type(error).__name__}: {error}"[:200]
@@ -563,18 +582,18 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
     result["llm_serving_host_loop_tokens_per_sec"] = round(
         emitted["n"] / elapsed, 1)
 
-    # -- same loop with PIPELINED fused decode blocks: 64 decode steps
-    # per dispatch, 3 blocks in flight chained device-side, emitted
-    # tokens copied back asynchronously.  Each block retire costs one
-    # result-fetch round trip through the tunnel regardless of data
-    # size, so the block is sized to amortize it (64 measured ~20%
-    # over 32 at ~100 ms RTT; on a co-located chip the loop is
-    # device-bound and the size matters much less).
+    # -- same loop with PIPELINED fused decode blocks: 32 decode steps
+    # per dispatch, up to 6 blocks in flight chained device-side,
+    # emitted tokens copied back asynchronously.  Block sizing swept on
+    # v5e round 4 (the flat-cache decode step cut block compute ~40%,
+    # so deeper pipelines of smaller blocks hide the tunnel RTT better
+    # than round 3's 64x3: int8 best 1950 tok/s at 32x6 vs 1830 at
+    # 64x3, with the 128-token budget capping coverage at 4 blocks).
     def serve(serve_params, label):
         batcher = ContinuousBatcher(params=serve_params, config=config,
                                     max_slots=slots, max_seq=max_seq,
                                     prefill_chunk=chunk,
-                                    decode_block=64, inflight=3)
+                                    decode_block=32, inflight=6)
         # Warm a full admission burst so the batched-prefill N=8 bucket
         # and the fused decode block both compile outside the timer.
         for i in range(slots):
