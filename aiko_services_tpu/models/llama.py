@@ -679,19 +679,6 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
         and cache_extent >= c.flash_decode_threshold
         and cache_extent % 128 == 0)
 
-    def factory(k_layer, v_layer):
-        def kv_write(q, k, v):
-            q = apply_rope(q, rope_table, positions)
-            k = apply_rope(k, rope_table, positions)
-            # The cache stays a read-only scan input; only the token's
-            # k/v leave the scan (see _forward_layers / the post-scan
-            # scatter below).
-            kv_write.updated = (k, v)
-            return attention_decode_append(
-                q, _grouped(k_layer, c.n_kv_heads),
-                _grouped(v_layer, c.n_kv_heads), k, v, lengths)
-        return kv_write
-
     def scatter_tokens(updates):
         # One dynamic_update_slice per batch row, unrolled.  A single
         # batched scatter (``.at[:, arange(b), lengths].set``) defeats
@@ -749,6 +736,19 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
             (params["layers"], jnp.arange(c.n_layers)))
         return _finish(params, c, hidden)[:, 0, :], \
             scatter_tokens(updates)
+
+    def factory(k_layer, v_layer):
+        def kv_write(q, k, v):
+            q = apply_rope(q, rope_table, positions)
+            k = apply_rope(k, rope_table, positions)
+            # The cache stays a read-only scan input; only the token's
+            # k/v leave the scan (see _forward_layers / the post-scan
+            # scatter above).
+            kv_write.updated = (k, v)
+            return attention_decode_append(
+                q, _grouped(k_layer, c.n_kv_heads),
+                _grouped(v_layer, c.n_kv_heads), k, v, lengths)
+        return kv_write
 
     logits, new_cache, _ = _forward_layers(
         params, c, params["embed"][tokens][:, None, :], cache, factory,

@@ -105,12 +105,15 @@ def test_decode_step_flash_int8_kv():
 
 def test_auto_threshold_resolves_at_trace_time():
     """'auto' uses dense below the threshold and flash at/above it --
-    both must produce correct results on the same config object."""
+    both must produce correct results on the same config object.
+    max_seq=128: the auto gate also requires a block-aligned extent
+    (cache_extent % 128 == 0), so 128 is the smallest extent where the
+    flash side actually takes the kernel path."""
     config = llama.LlamaConfig.tiny(
-        vocab_size=64, max_seq=64)
+        vocab_size=64, max_seq=128)
     small = dataclasses.replace(config, flash_decode_threshold=32)
-    dense_logits = _fixed_token_decode(config)       # 64 < 4096: dense
-    flash_logits = _fixed_token_decode(small)        # 64 >= 32: flash
+    dense_logits = _fixed_token_decode(config)      # 128 < 1024: dense
+    flash_logits = _fixed_token_decode(small)       # 128 >= 32: flash
     np.testing.assert_allclose(np.asarray(flash_logits),
                                np.asarray(dense_logits),
                                atol=5e-2, rtol=2e-2)
