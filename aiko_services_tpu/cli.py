@@ -319,6 +319,41 @@ def convert_detector_cmd(source, destination):
     click.echo(json.dumps({"destination": destination}))
 
 
+# -- media conversion -------------------------------------------------------
+
+@main.group()
+def media():
+    """Media conversion (reference images_to_video / video_to_images)."""
+
+
+@media.command("images-to-video")
+@click.argument("pattern")
+@click.argument("output")
+@click.option("--rate", default=29.97, help="output frame rate")
+@click.option("--codec", default="MJPG", help="fourcc codec")
+def images_to_video_cmd(pattern, output, rate, codec):
+    """Encode images matching PATTERN (glob or '{}' template) into the
+    OUTPUT video file, via a real ImageReadFile->VideoWriteFile
+    pipeline (reference elements/media/images_to_video.py:1-33)."""
+    from .media_convert import images_to_video
+
+    frames = images_to_video(pattern, output, rate=rate, codec=codec)
+    click.echo(json.dumps({"frames": frames, "output": output}))
+
+
+@media.command("video-to-images")
+@click.argument("video")
+@click.argument("pattern")
+def video_to_images_cmd(video, pattern):
+    """Decode VIDEO into per-frame images at PATTERN (a '{}' template,
+    e.g. out/frame_{}.png), via a real VideoReadFile->ImageWriteFile
+    pipeline (reference elements/media/video_to_images.py:1-42)."""
+    from .media_convert import video_to_images
+
+    frames = video_to_images(video, pattern)
+    click.echo(json.dumps({"frames": frames, "pattern": pattern}))
+
+
 # -- broker -----------------------------------------------------------------
 
 @main.command()

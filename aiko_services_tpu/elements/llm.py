@@ -309,6 +309,19 @@ class LLM(PipelineElement):
         self._batcher.submit(request)
         self._start_pump()
 
+    def stop_stream(self, stream, stream_id):
+        """Cancel the stream's outstanding requests: a frame parked here
+        when its stream is destroyed must stop decoding (it would
+        otherwise run to max_new_tokens in a device batch slot) and its
+        parked ``complete`` must not fire later."""
+        prefix = f"{stream.stream_id}/"
+        for request_id in [rid for rid in self._completes
+                           if str(rid).startswith(prefix)]:
+            self._completes.pop(request_id, None)
+            if self._batcher is not None:
+                self._batcher.cancel(request_id)
+        return StreamEvent.OKAY, {}
+
     def _start_pump(self):
         if not self._pumping:
             self._pumping = True

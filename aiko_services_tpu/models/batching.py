@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import llama
+from ..utils.misc import next_power_of_two
 
 __all__ = ["Request", "ContinuousBatcher"]
 
@@ -211,9 +212,7 @@ class ContinuousBatcher:
         if not admitting:
             return
         n = len(admitting)
-        bucket = 1
-        while bucket < n:
-            bucket *= 2
+        bucket = next_power_of_two(n)
         rows = admitting + [admitting[0]] * (bucket - n)
         tokens = np.zeros((bucket, self.prefill_chunk), dtype=np.int32)
         slot_rows = np.zeros(bucket, dtype=np.int32)
@@ -429,14 +428,43 @@ class ContinuousBatcher:
             request.emit(request.request_id, token, finished)
         if finished:
             request.done = True
-            slot = request.slot
-            self.slots[slot] = None
-            self.lengths[slot] = 0
-            self.current[slot] = 0
-            self.temperatures[slot] = 0.0
-            self._temps_dev = None
-            self.decoding[slot] = False
-            self._active_dev = None
+            self._free_slot(request.slot)
+
+    def _free_slot(self, slot: int):
+        """Release a slot's host-side state (finish and cancel paths
+        share this -- any new per-slot bookkeeping belongs here)."""
+        self.slots[slot] = None
+        self.lengths[slot] = 0
+        self.current[slot] = 0
+        self.temperatures[slot] = 0.0
+        self._temps_dev = None
+        self.decoding[slot] = False
+        self._active_dev = None
+
+    def cancel(self, request_id: str) -> bool:
+        """Abandon a request by id: pending requests leave the queue; an
+        admitted request frees its slot immediately, so it stops
+        occupying a device batch row from the next dispatch on.  Tokens
+        for it inside already-in-flight fused blocks are discarded at
+        retire via the snapshot identity check -- the same overshoot
+        semantics a finished request has.  ``emit`` is never called for
+        a cancelled request.  Returns True when a request was found."""
+        found = False
+        for request in list(self.pending):
+            if request.request_id == request_id:
+                self.pending.remove(request)
+                request.done = True
+                found = True
+        for slot, request in enumerate(self.slots):
+            if request is None or request.request_id != request_id:
+                continue
+            request.done = True
+            self._free_slot(slot)
+            # A first-token sample parked for the next block dispatch
+            # belongs to this slot's (now cancelled) occupant.
+            self._pending_first.pop(slot, None)
+            found = True
+        return found
 
     # -- introspection -----------------------------------------------------
 

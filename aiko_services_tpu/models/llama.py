@@ -657,9 +657,49 @@ def prefill_into_slots(params: dict, config: LlamaConfig,
     return logits, cache
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
-                cache: dict, lengths: jax.Array) \
+def _cache_distributed(cache) -> bool:
+    """True when the cache payload lives sharded across more than one
+    device.  The Pallas decode kernel (a custom call) has no GSPMD
+    partitioning rules, so jit would wrap it in a full-cache all-gather
+    every layer -- dense attention, whose einsums GSPMD partitions
+    natively, is always faster there.  Tracers (calls from inside
+    another jit) carry no sharding and resolve as resident."""
+    arr = cache_array(cache)
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return (len(sharding.device_set) > 1
+                and not sharding.is_fully_replicated)
+    except (AttributeError, TypeError):
+        return False
+
+
+def _resolve_decode_flash(c: LlamaConfig, cache: dict) -> bool:
+    """Pick the decode attention path EAGERLY (outside jit), where the
+    cache's sharding is visible.  'auto' silently keeps dense for a
+    distributed cache; explicit 'flash' raises rather than compiling a
+    per-layer all-gather of the whole cache."""
+    if c.decode_attention == "flash":
+        if _cache_distributed(cache):
+            raise ValueError(
+                "decode_attention='flash' needs the KV cache resident "
+                "on one device (pallas_call has no GSPMD partitioning "
+                "rules; a tp/dp-sharded cache would be all-gathered in "
+                "full every layer).  Use 'dense' -- or 'auto', which "
+                "falls back -- when serving with a sharded cache.")
+        return True
+    cache_extent = cache_array(cache).shape[2]
+    return (c.decode_attention == "auto"
+            and cache_extent >= c.flash_decode_threshold
+            and cache_extent % 128 == 0
+            and not _cache_distributed(cache))
+
+
+def _decode_step_impl(params: dict, config: LlamaConfig,
+                      tokens: jax.Array, cache: dict,
+                      lengths: jax.Array,
+                      use_flash: bool | None = None) \
         -> tuple[jax.Array, dict]:
     """One token per active sequence.
 
@@ -671,13 +711,16 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     positions = lengths[:, None]                       # [B, 1]
     cache_extent = cache_array(cache).shape[2]
-    # The stacked kernel needs a block-aligned cache extent (it never
-    # pads -- padding a stacked cache would copy it); "auto" quietly
-    # keeps dense for exotic extents, explicit "flash" raises there.
-    use_flash = c.decode_attention == "flash" or (
-        c.decode_attention == "auto"
-        and cache_extent >= c.flash_decode_threshold
-        and cache_extent % 128 == 0)
+    if use_flash is None:
+        # In-jit callers (decode_block's scan, bench loops) have no
+        # sharding to inspect; resolve on extent alone, as before.  The
+        # stacked kernel needs a block-aligned cache extent (it never
+        # pads -- padding a stacked cache would copy it); "auto" quietly
+        # keeps dense for exotic extents, explicit "flash" raises there.
+        use_flash = c.decode_attention == "flash" or (
+            c.decode_attention == "auto"
+            and cache_extent >= c.flash_decode_threshold
+            and cache_extent % 128 == 0)
 
     def scatter_tokens(updates):
         # One dynamic_update_slice per batch row, unrolled.  A single
@@ -756,6 +799,25 @@ def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
     return logits[:, 0, :], new_cache
 
 
+_decode_step_jit = partial(jax.jit, static_argnames=("config", "use_flash"),
+                           donate_argnames=("cache",))(_decode_step_impl)
+
+
+def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
+                cache: dict, lengths: jax.Array) \
+        -> tuple[jax.Array, dict]:
+    """One decode token per active sequence (see _decode_step_impl).
+    The flash-vs-dense choice resolves HERE, where the concrete cache's
+    sharding is visible -- 'auto' never routes a tp/dp-sharded cache
+    into the partitioning-rule-less Pallas kernel."""
+    return _decode_step_jit(params, config, tokens, cache, lengths,
+                            use_flash=_resolve_decode_flash(config, cache))
+
+
+# In-jit composition hook (bench loops fuse N steps in one dispatch).
+decode_step.__wrapped__ = _decode_step_impl
+
+
 def greedy_sample(logits: jax.Array) -> jax.Array:
     return jnp.argmax(logits, axis=-1)
 
@@ -777,12 +839,12 @@ def select_tokens(key: jax.Array, logits: jax.Array,
     return jnp.where(temperatures > 0, sampled, greedy)
 
 
-@partial(jax.jit, static_argnames=("config", "num_steps"),
+@partial(jax.jit, static_argnames=("config", "num_steps", "use_flash"),
          donate_argnames=("cache",))
-def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
-                 cache: dict, lengths: jax.Array, active: jax.Array,
-                 temperatures: jax.Array, key: jax.Array, *,
-                 num_steps: int) \
+def _decode_block_jit(params: dict, config: LlamaConfig, tokens: jax.Array,
+                      cache: dict, lengths: jax.Array, active: jax.Array,
+                      temperatures: jax.Array, key: jax.Array, *,
+                      num_steps: int, use_flash: bool) \
         -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, dict]:
     """``num_steps`` decode iterations fused into ONE dispatch
     (sampling included), amortizing the host round trip -- through a
@@ -808,8 +870,9 @@ def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
     def body(carry, _):
         tokens, cache, lengths, key = carry
         positions = jnp.where(active, jnp.minimum(lengths, trash), trash)
-        logits, cache = decode_step.__wrapped__(params, config, tokens,
-                                                cache, positions)
+        logits, cache = _decode_step_impl(params, config, tokens,
+                                          cache, positions,
+                                          use_flash=use_flash)
         key, sub = jax.random.split(key)
         tokens = select_tokens(sub, logits, temperatures).astype(
             jnp.int32)
@@ -819,3 +882,20 @@ def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
     (tokens, cache, lengths, key), emitted = jax.lax.scan(
         body, (tokens, cache, lengths, key), None, length=num_steps)
     return emitted, tokens, lengths, key, cache
+
+
+def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
+                 cache: dict, lengths: jax.Array, active: jax.Array,
+                 temperatures: jax.Array, key: jax.Array, *,
+                 num_steps: int) \
+        -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, dict]:
+    """num_steps fused decode iterations (see _decode_block_jit); the
+    flash-vs-dense choice resolves here on the concrete cache's
+    sharding, exactly as in :func:`decode_step`."""
+    return _decode_block_jit(params, config, tokens, cache, lengths,
+                             active, temperatures, key,
+                             num_steps=num_steps,
+                             use_flash=_resolve_decode_flash(config, cache))
+
+
+decode_block.__wrapped__ = _decode_block_jit.__wrapped__

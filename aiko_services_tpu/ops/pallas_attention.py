@@ -141,7 +141,7 @@ def _round_up(n, multiple):
 @functools.partial(jax.jit, static_argnames=(
     "causal", "block_q", "block_k", "interpret", "pack_heads"))
 def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
-                    block_q: int = 512, block_k: int = 1024,
+                    block_q: int = 512, block_k: int = 2048,
                     interpret: bool | None = None,
                     pack_heads: bool = False):
     """Causal flash attention.
@@ -156,12 +156,18 @@ def flash_attention(q, k, v, q_offset=0, *, causal: bool = True,
     for bf16 inputs -- ~0.4% per-weight, the same order as V's own
     rounding; see _online_update).
 
-    Default blocks (512 x 1024) are tuned on v5e at head_dim 64 / 8k
-    context: ~41% of chip peak on the fully-live causal region on an
-    uncontended run (the round-3 29.9% record carried tunnel noise; the
-    round-2 kernel measured ~16%).  The non-matmul gap is VPU softmax
+    Default blocks (512 x 2048) are tuned on v5e at head_dim 64 / 8k
+    context -- the round-5 sweep with 600-iteration amortized min-of-3
+    timing: 30.3% of chip peak at 512x2048 vs 26.0% at the old 512x1024
+    default, 29.4% at 1024x1024, 15.8% at 512x512; 1024x2048 exceeds
+    VMEM (the f32 [block_q, block_k] score tile is the binding
+    constraint: 512x2048x4 B = 4 MB fits, 8 MB does not).  Earlier
+    rounds' claims of ~41% did not reproduce under this methodology and
+    are revised down in BASELINE.md.  The non-matmul gap is VPU softmax
     work, cut by the interior/boundary split (most blocks skip masking
-    entirely), the bf16 exp, and folding the scale into q.
+    entirely), the bf16 exp, and folding the scale into q; the d=64
+    contraction half-feeds the 128-wide MXU, putting the practical
+    ceiling near 50%.
 
     ``pack_heads`` pairs two kv heads per grid row with block-diagonal
     queries, filling the 128-wide MXU dimension that a d=64 contraction

@@ -475,6 +475,15 @@ def flash_decode_append(q, k_cache, v_cache, k_new, v_new, lengths, *,
     function a scan slice materializes a per-layer cache copy.
     """
     b, _, h, d = q.shape
+    if is_quantized(k_cache) != is_quantized(v_cache):
+        # init_cache quantizes k and v together; a mixed pair can only
+        # come from caller error, and the kernel keys its dequant on the
+        # k scales alone -- a raw v would be read as int8 garbage.
+        raise ValueError(
+            "flash_decode_append: k_cache and v_cache must share one "
+            "quantization state (both int8 layers or both raw arrays); "
+            f"got k quantized={is_quantized(k_cache)}, "
+            f"v quantized={is_quantized(v_cache)}")
     if is_quantized(k_cache):
         k_payload = k_cache["int8"]
         k_scale_t = k_cache["scale"][..., 0].transpose(0, 2, 1) \
@@ -514,6 +523,14 @@ def flash_decode_append_stacked(q, k_view, v_view, layer, k_new, v_new,
     b, _, h, d = q.shape
     k_payload, k_scale_t = k_view
     v_payload, v_scale_t = v_view
+    if (k_scale_t is None) != (v_scale_t is None):
+        # Same invariant as flash_decode_append: the kernel keys its
+        # in-kernel dequant on the k scales alone.
+        raise ValueError(
+            "flash_decode_append_stacked: k and v views must share one "
+            "quantization state (init_cache quantizes them together); "
+            f"got k quantized={k_scale_t is not None}, "
+            f"v quantized={v_scale_t is not None}")
     kv = k_payload.shape[3] // d
 
     q_flat = q[:, 0]

@@ -554,11 +554,15 @@ class Pipeline(Actor):
         node_name = node.name
         start = time.perf_counter()
         state = {"done": False}
+        state_lock = threading.Lock()   # complete() may race itself
+                                        # across threads; the resume
+                                        # post must fire exactly once
 
         def complete(event, outputs=None):
-            if state["done"]:
-                return                  # double completion: ignore
-            state["done"] = True
+            with state_lock:
+                if state["done"]:
+                    return              # double completion: ignore
+                state["done"] = True
             self.post_self("resume_frame_local",
                            [stream_id, frame_id, node_name, event,
                             outputs or {},
@@ -568,7 +572,8 @@ class Pipeline(Actor):
             node.element.process_frame_start(stream, complete, **inputs)
         except Exception as error:
             self.logger.exception("element %s submit raised", node_name)
-            state["done"] = True        # a late complete() must not win
+            with state_lock:
+                state["done"] = True    # a late complete() must not win
             frame.paused_pe_name = None
             self._frame_error(stream, frame, f"{node_name}: {error}")
 

@@ -70,6 +70,7 @@ class EventEngine:
         self._lock = threading.Lock()
         self._current_timer: _Timer | None = None
         self._idle_waiters: list[asyncio.Future] = []
+        self._drained_callbacks: list[tuple] = []
 
     # -- loop lifecycle ----------------------------------------------------
 
@@ -109,6 +110,9 @@ class EventEngine:
                 if progressed:
                     # Yield so coroutines/tasks scheduled by handlers run,
                     # then immediately continue draining.
+                    await asyncio.sleep(0)
+                    continue
+                if self._run_drained_callbacks():
                     await asyncio.sleep(0)
                     continue
                 self._notify_idle()
@@ -189,6 +193,27 @@ class EventEngine:
         else:
             with self._lock:
                 self._pending_pre_loop.append(lambda: self._call(fn, *args))
+
+    def post_when_drained(self, fn: Callable, *args):
+        """Thread-safe: run ``fn(*args)`` on the event loop once every
+        mailbox has drained -- i.e. after the CURRENT BURST of queued
+        work (frame ingests, messages) has all been handled, but before
+        the loop sleeps.  Micro-batching elements (elements/detect.py)
+        use this to flush exactly when no more same-burst frames can
+        arrive; ``post_deferred`` is unsuitable there because its
+        callback interleaves after ONE mailbox item, not after the
+        burst."""
+        with self._lock:
+            self._drained_callbacks.append((fn, args))
+        self._signal()
+
+    def _run_drained_callbacks(self) -> bool:
+        with self._lock:
+            callbacks, self._drained_callbacks = \
+                self._drained_callbacks, []
+        for fn, args in callbacks:
+            self._call(fn, *args)
+        return bool(callbacks)
 
     # -- timers ------------------------------------------------------------
 

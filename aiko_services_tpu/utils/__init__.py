@@ -8,4 +8,6 @@ from .configuration import (
     env_flag, env_int, env_float)
 from .logger import get_logger, TransportLogHandler, RateLimiter
 from .misc import (LRUCache, load_module, load_class, find_free_port,
-                   utc_iso8601, epoch_to_iso8601, process_memory_rss)
+                   utc_iso8601, epoch_to_iso8601, process_memory_rss,
+                   next_power_of_two)
+from .trace import MethodTrace, trace_methods, record_calls

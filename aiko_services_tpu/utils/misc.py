@@ -15,7 +15,18 @@ from collections import OrderedDict
 from datetime import datetime, timezone
 
 __all__ = ["LRUCache", "load_module", "load_class", "find_free_port",
-           "utc_iso8601", "epoch_to_iso8601", "process_memory_rss"]
+           "utc_iso8601", "epoch_to_iso8601", "process_memory_rss",
+           "next_power_of_two"]
+
+
+def next_power_of_two(n: int) -> int:
+    """Smallest power of two >= n (compile-shape bucketing: batched
+    dispatch sites pad ragged batches up to one of log2(N) buckets so
+    XLA compiles once per bucket, not once per batch size)."""
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return bucket
 
 
 class LRUCache:

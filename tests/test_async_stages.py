@@ -226,6 +226,99 @@ def test_detector_async_matches_sync(tmp_path, runtime):
     pipeline.stop()
 
 
+def test_detector_microbatches_burst(tmp_path, runtime):
+    """A burst of parked frames dispatches as ONE batched detect (r5:
+    elements/detect.py micro-batching), and each frame still gets ITS
+    OWN row's outputs -- identical to the per-frame blocking path."""
+    n_frames = 4
+    definition = {
+        "version": 0, "name": "detect_burst", "runtime": "jax",
+        "graph": ["(detect)"],
+        "elements": [{
+            "name": "detect",
+            "input": [{"name": "image"}],
+            "output": [{"name": "detections"}, {"name": "overlay"}],
+            "parameters": {"width": 4, "max_batch": 8},
+            "deploy": {"local": {
+                "module": "aiko_services_tpu.elements.detect",
+                "class_name": "Detector"}}}]}
+    path = tmp_path / "detect.json"
+    path.write_text(json.dumps(definition))
+    responses = queue.Queue()
+    pipeline = create_pipeline(str(path), runtime=runtime)
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    rng = np.random.default_rng(0)
+    images = [rng.integers(0, 255, (64, 64, 3)).astype(np.uint8)
+              for _ in range(n_frames)]
+    for image in images:
+        pipeline.create_frame_local(stream, {"image": image})
+    assert run_until(runtime, lambda: responses.qsize() >= n_frames,
+                     timeout=120.0)
+
+    element = pipeline.graph.get_node("detect").element
+    dispatches = element.jit_cache.hits + element.jit_cache.misses
+    assert dispatches < n_frames, (
+        f"{dispatches} dispatches for {n_frames} frames: not batched")
+
+    by_frame = {}
+    while not responses.empty():
+        _, frame_id, swag, _, okay, diagnostic = responses.get()
+        assert okay, diagnostic
+        by_frame[frame_id] = swag
+    assert len(by_frame) == n_frames
+    for frame_id, image in enumerate(images):
+        _, sync_out = element.process_frame(stream, image=image)
+        assert by_frame[frame_id]["detections"] \
+            == sync_out["detections"]
+        assert by_frame[frame_id]["overlay"] == sync_out["overlay"]
+    pipeline.stop()
+
+
+def test_detector_bad_frame_errors_only_its_group(tmp_path, runtime):
+    """A malformed frame in a micro-batched burst must error ITSELF
+    (its shape group / its stream -- a frame error destroys its stream
+    by engine design) while other streams' frames in the SAME batched
+    burst complete: a failed dispatch must never strand parked frames."""
+    definition = {
+        "version": 0, "name": "detect_bad", "runtime": "jax",
+        "graph": ["(detect)"],
+        "elements": [{
+            "name": "detect",
+            "input": [{"name": "image"}],
+            "output": [{"name": "detections"}],
+            "parameters": {"width": 4, "max_batch": 8},
+            "deploy": {"local": {
+                "module": "aiko_services_tpu.elements.detect",
+                "class_name": "Detector"}}}]}
+    path = tmp_path / "detect.json"
+    path.write_text(json.dumps(definition))
+    good_responses = queue.Queue()
+    bad_responses = queue.Queue()
+    pipeline = create_pipeline(str(path), runtime=runtime)
+    good_stream = pipeline.create_stream_local(
+        "good", queue_response=good_responses)
+    bad_stream = pipeline.create_stream_local(
+        "bad", queue_response=bad_responses)
+    rng = np.random.default_rng(0)
+    pipeline.create_frame_local(good_stream, {
+        "image": rng.integers(0, 255, (64, 64, 3)).astype(np.uint8)})
+    pipeline.create_frame_local(bad_stream, {   # no channel dim
+        "image": rng.integers(0, 255, (64, 64)).astype(np.uint8)})
+    pipeline.create_frame_local(good_stream, {
+        "image": rng.integers(0, 255, (64, 64, 3)).astype(np.uint8)})
+    assert run_until(
+        runtime,
+        lambda: good_responses.qsize() >= 2 and not bad_responses.empty(),
+        timeout=120.0)
+    *_, okay, diagnostic = bad_responses.get()
+    assert not okay and "detect" in diagnostic    # dispatch error surfaced
+    while not good_responses.empty():             # burst-mates completed
+        _, _, swag, _, okay, diagnostic = good_responses.get()
+        assert okay, diagnostic
+        assert isinstance(swag["detections"], list)
+    pipeline.stop()
+
+
 def test_llm_batches_across_frames(tmp_path, runtime):
     """Multiple in-flight frames' requests decode TOGETHER in the shared
     batcher (continuous batching across frames, not per-frame drains):

@@ -119,6 +119,59 @@ def test_auto_threshold_resolves_at_trace_time():
                                atol=5e-2, rtol=2e-2)
 
 
+def test_sharded_cache_never_reaches_flash():
+    """ADVICE r4 (medium): pallas_call has no GSPMD partitioning rules,
+    so a tp-sharded cache must never reach the flash kernel.  'auto'
+    (the default) silently keeps dense for a distributed cache even at
+    flash-eligible extents; explicit 'flash' raises eagerly instead of
+    compiling a per-layer full-cache all-gather."""
+    import pytest
+
+    from aiko_services_tpu.parallel import MeshPlan, make_mesh
+
+    base = dataclasses.replace(
+        llama.LlamaConfig.tiny(vocab_size=64, max_seq=128),
+        flash_decode_threshold=32)          # 128 is flash-eligible
+    params = llama.init_params(jax.random.PRNGKey(0), base)
+    plan = MeshPlan(make_mesh({"tp": 2}, jax.devices()[:2]))
+    cache = jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, plan.shard(*s)),
+        llama.init_cache(base, 2), llama.cache_specs(base))
+    tokens = jnp.asarray([3, 5], dtype=jnp.int32)
+    lengths = jnp.asarray([4, 4], dtype=jnp.int32)
+
+    flash = dataclasses.replace(base, decode_attention="flash")
+    with pytest.raises(ValueError, match="resident"):
+        llama.decode_step(params, flash, tokens, cache, lengths)
+
+    auto = dataclasses.replace(base, decode_attention="auto")
+    logits, _ = llama.decode_step(params, auto, tokens, cache, lengths)
+    assert bool(jnp.isfinite(logits.astype(jnp.float32)).all())
+
+    # The same extent with a RESIDENT cache still picks flash (the gate
+    # only bites when the cache is actually distributed).
+    resident = llama.init_cache(base, 2)
+    from aiko_services_tpu.models.llama import _resolve_decode_flash
+    assert _resolve_decode_flash(auto, resident) is True
+    assert _resolve_decode_flash(auto, cache) is False
+
+
+def test_mixed_quantization_cache_rejected():
+    """ADVICE r4: the kernel keys its in-kernel dequant on the k scales
+    alone; a half-quantized k/v pair is caller error and must raise, not
+    silently misread v."""
+    import pytest
+
+    q, k_cache, v_cache, k_new, v_new, lengths = _random_case(
+        jax.random.PRNGKey(2))
+    with pytest.raises(ValueError, match="quantization state"):
+        flash_decode_append(q, quantize_kv(k_cache), v_cache, k_new,
+                            v_new, lengths)
+    with pytest.raises(ValueError, match="quantization state"):
+        flash_decode_append(q, k_cache, quantize_kv(v_cache), k_new,
+                            v_new, lengths)
+
+
 def test_dense_int8_diffuse_tail_error_mode():
     """ADVICE r3 (medium): the DENSE int8 path quantizes softmax weights
     per (b, h) with step = row_max / 127; a distribution with one spike

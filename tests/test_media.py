@@ -311,3 +311,35 @@ def test_audio_graph_xy_max_frequency():
     # x axis now spans 0..2 kHz over 128 kept bins: the 0.5 kHz peak
     # lands at ~1/4 of the width instead of 1/8.
     assert abs(int(bar_rows.argmax()) - 16) <= 1
+
+
+# -- media conversion utilities ---------------------------------------------
+
+def test_images_to_video_to_images_roundtrip(tmp_path, runtime):
+    """The conversion utilities (reference images_to_video.py:1-33,
+    video_to_images.py:1-42): a directory of images encodes into a
+    video; that video decodes back into the same number of frames."""
+    cv2 = pytest.importorskip("cv2")
+    del cv2
+    from PIL import Image
+
+    from aiko_services_tpu.media_convert import (images_to_video,
+                                                 video_to_images)
+
+    for i in range(5):
+        Image.new("RGB", (32, 24), (i * 40, 30, 40)).save(
+            tmp_path / f"frame_{i}.png")
+    video = tmp_path / "clip.avi"
+    frames = images_to_video(f"{tmp_path}/frame_*.png", str(video),
+                             rate=10.0, runtime=runtime)
+    assert frames == 5
+    assert video.exists() and video.stat().st_size > 0
+
+    out_pattern = tmp_path / "decoded" / "img_{}.png"
+    frames = video_to_images(str(video), str(out_pattern),
+                             runtime=runtime)
+    assert frames == 5
+    decoded = sorted((tmp_path / "decoded").glob("img_*.png"))
+    assert len(decoded) == 5
+    with Image.open(decoded[0]) as image:
+        assert image.size == (32, 24)

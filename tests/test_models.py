@@ -279,6 +279,37 @@ def test_decode_block_matches_single_steps(tiny):
     assert [len(v) for v in blocked.values()] == [5, 9, 4]
 
 
+def test_cancel_frees_slot_and_stops_emits(tiny):
+    """ADVICE r4: cancel() removes a queued request, frees an admitted
+    request's slot immediately, and suppresses every later emit for it
+    -- including tokens for it inside already-in-flight fused blocks."""
+    config, params = tiny
+    tok = ByteTokenizer()
+    out: dict = {}
+
+    def emit(r, t, f):
+        out.setdefault(r, []).append((t, f))
+
+    batcher = ContinuousBatcher(params, config, max_slots=2, max_seq=64,
+                                prefill_chunk=16, decode_block=4,
+                                inflight=2)
+    for i in range(3):                       # r2 queues behind 2 slots
+        batcher.submit(Request(f"r{i}", tok.encode(f"cancel {i}"),
+                               max_new_tokens=12, emit=emit))
+    assert batcher.cancel("r2") is True      # still pending
+    assert batcher.queue_depth == 2          # r0, r1 remain queued
+    batcher.step()                           # admit + one block in flight
+    assert batcher.cancel("r0") is True      # admitted, mid-decode
+    emitted_at_cancel = len(out.get("r0", []))
+    assert batcher.active_count == 1         # slot freed immediately
+    batcher.run_until_drained(max_steps=200)
+    assert batcher.cancel("missing") is False
+    assert len(out.get("r0", [])) == emitted_at_cancel   # no late emits
+    assert "r2" not in out                   # never admitted
+    assert [f for _, f in out["r1"]][-1] is True         # r1 unaffected
+    assert len(out["r1"]) == 12
+
+
 def test_pipelined_blocks_match_single_steps(tiny):
     """The in-flight pipelined decode (inflight > 1, device-chained
     dispatches) emits exactly the streams the synchronous single-step

@@ -1,0 +1,69 @@
+"""Method-trace interceptor (reference main/proxy.py:36-75
+ProxyAllMethods + proxy_trace)."""
+
+import logging
+
+import pytest
+
+from aiko_services_tpu.utils import record_calls, trace_methods
+
+
+class Example:
+    def __init__(self):
+        self.state = 0
+
+    def bump(self, amount, scale=1):
+        self.state += amount * scale
+        return self.state
+
+    def fail(self):
+        raise ValueError("boom")
+
+    def _private(self):
+        return "untraced"
+
+
+def test_trace_records_calls_and_shares_state():
+    calls = []
+    target = Example()
+    traced = trace_methods(target, interceptor=record_calls(calls))
+    assert traced.bump(2, scale=3) == 6
+    assert traced.bump(1) == 7
+    assert target.state == 7                 # same object, not a copy
+    assert calls == [("bump", (2,), {"scale": 3}, 6),
+                     ("bump", (1,), {}, 7)]
+    # non-callables and _private pass through unwrapped
+    assert traced.state == 7
+    assert traced._private() == "untraced"
+    assert calls[-1][0] == "bump"            # _private not recorded
+
+
+def test_default_interceptor_logs_enter_exit_and_errors():
+    # The framework logger does not propagate (it has its own console/
+    # fabric handlers), so capture with a handler attached directly.
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger = logging.getLogger("aiko.trace")
+    logger.addHandler(handler)
+    old_level = logger.level
+    logger.setLevel(logging.DEBUG)
+    traced = trace_methods(Example(), name="ex")
+    try:
+        traced.bump(1)
+        with pytest.raises(ValueError, match="boom"):
+            traced.fail()
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+    messages = " ".join(record.getMessage() for record in records)
+    assert "enter ex.bump" in messages
+    assert "exit  ex.bump" in messages
+    assert "error ex.fail" in messages       # exception still propagates
+
+
+def test_trace_setattr_writes_through():
+    target = Example()
+    traced = trace_methods(target)
+    traced.state = 42
+    assert target.state == 42
