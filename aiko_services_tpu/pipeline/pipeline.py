@@ -47,6 +47,10 @@ PROTOCOL_PIPELINE = f"{SERVICE_PROTOCOL_PREFIX}/pipeline:0"
 _BACKPRESSURE_DEPTH = 32          # frames queued before a source waits
 _BACKPRESSURE_SLEEP = 0.005
 _GRACE_TIME_DEFAULT = 120.0
+# A parked frame older than this many grace periods stops counting as
+# in-flight work when the stream's grace lease fires -- the backstop
+# against stages that never complete (see _stream_lease_expired).
+_STALL_REAP_FACTOR = 10
 _METRICS_MEMORY = False           # RSS deltas per element when True
 
 
@@ -308,8 +312,7 @@ class Pipeline(Actor):
         if grace_time:
             stream.lease = Lease(
                 self.runtime.engine, float(grace_time), stream_id,
-                expired_handler=lambda lease: self.destroy_stream(
-                    lease.lease_uuid))
+                expired_handler=self._stream_lease_expired)
         self.streams[stream_id] = stream
         self.ec_producer.update("streams", len(self.streams))
 
@@ -333,6 +336,36 @@ class Pipeline(Actor):
             self._current_stream_ref = None
         stream.state = StreamState.RUN
         return stream
+
+    def _stream_lease_expired(self, lease):
+        """A stream's grace lease reaps IDLE streams only.  The
+        reference extends its stream lease on every processed frame
+        (reference main/pipeline.py:1425 ``stream_lease.extend()``);
+        here frames can sit PARKED at async/remote stages for minutes
+        with no per-frame tick (a first-frame jit compile of a 1B model
+        takes >120 s through a congested link), so the expiry itself
+        re-checks: frames in flight, or activity within the last grace
+        period, revives the lease instead of destroying mid-work.  A
+        frame parked longer than ``_STALL_REAP_FACTOR`` grace periods
+        no longer counts as alive -- a remote stage that died without
+        replying, or an async element that never calls complete(),
+        must not pin the stream (and its swag tensors) forever."""
+        stream = self.streams.get(str(lease.lease_uuid))
+        if stream is not None:
+            now = time.monotonic()
+            stall_cap = lease.lease_time * _STALL_REAP_FACTOR
+            live_frames = any(now - frame.created < stall_cap
+                              for frame in stream.frames.values())
+            if live_frames or now - stream.last_frame_time \
+                    < lease.lease_time:
+                lease.revive()
+                return
+            if stream.frames:
+                self.logger.error(
+                    "stream %s: reaping with %d frame(s) parked beyond "
+                    "%.0f s (stage never completed)", stream.stream_id,
+                    len(stream.frames), stall_cap)
+        self.destroy_stream(lease.lease_uuid)
 
     def _stream_path(self, stream: Stream):
         return self.graph.get_path(stream.graph_path)
@@ -433,6 +466,7 @@ class Pipeline(Actor):
         if stream.state not in (StreamState.START, StreamState.RUN):
             stream.frames.pop(frame.frame_id, None)
             return
+        stream.last_frame_time = time.monotonic()   # grace lease clock
         self.run_hook("pipeline.process_frame:0",
                       lambda: {"stream": stream.stream_id,
                                "frame": frame.frame_id})
@@ -665,6 +699,7 @@ class Pipeline(Actor):
     def _frame_done(self, stream: Stream, frame: Frame, nodes):
         frame.metrics["time_pipeline"] = (
             time.perf_counter() - frame.metrics["time_pipeline_start"])
+        stream.last_frame_time = time.monotonic()   # grace lease clock
         stream.frames.pop(frame.frame_id, None)
         self._frames_processed += 1
         self.share["frames_processed"] = self._frames_processed

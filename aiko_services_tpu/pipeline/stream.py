@@ -67,6 +67,7 @@ class Stream:
     queue_response: Any = None                      # local queue.Queue
     lease: Any = None
     generator_handles: list = field(default_factory=list)
+    last_frame_time: float = field(default_factory=time.monotonic)
 
     def next_frame_id(self) -> int:
         frame_id = self.frame_count

@@ -59,6 +59,14 @@ class Lease:
                 self._engine.remove_timer_handler(self._timer)
             self._arm()
 
+    def revive(self, lease_time: float | None = None):
+        """Un-expire from inside an ``expired_handler``: re-arm for
+        another period.  For handlers that decide the lease must live
+        on -- e.g. a stream grace lease firing while frames are still
+        in flight (``Pipeline._stream_lease_expired``)."""
+        self._terminated = False
+        self.extend(lease_time)
+
     def terminate(self):
         self._terminated = True
         if self._timer is not None:

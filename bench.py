@@ -736,8 +736,12 @@ def bench_pipeline_e2e() -> dict:
             element("LLM", "LLM", ["text"], ["text"],
                     # The serving-shaped decode config: llama3-1b-class
                     # weights, int8, fused blocks (3 in flight).
+                    # decode_block=32 = max_new_tokens: each request's
+                    # whole caption decodes in ONE fused dispatch, so
+                    # the pump pays ~1 host round trip per request wave
+                    # instead of 2-3 (the host loop is RTT-bound here).
                     {"model": "llama3-1b", "max_seq": 512,
-                     "quantize": "int8", "decode_block": 16,
+                     "quantize": "int8", "decode_block": 32,
                      "inflight": 3, "max_new_tokens": 32},
                     module="aiko_services_tpu.elements.llm"),
         ]}

@@ -70,6 +70,16 @@ class AsyncError(PipelineElement):
             StreamEvent.ERROR, {"diagnostic": "boom"})).start()
 
 
+class NeverComplete(PipelineElement):
+    """Async element that parks the frame and never calls complete --
+    models a dead remote stage / wedged accelerator."""
+
+    is_async = True
+
+    def process_frame_start(self, stream, complete, value=None, **inputs):
+        pass
+
+
 class DoubleComplete(PipelineElement):
     is_async = True
 
@@ -316,6 +326,55 @@ def test_detector_bad_frame_errors_only_its_group(tmp_path, runtime):
         _, _, swag, _, okay, diagnostic = good_responses.get()
         assert okay, diagnostic
         assert isinstance(swag["detections"], list)
+    pipeline.stop()
+
+
+def test_grace_lease_survives_parked_frames_then_reaps_idle(
+        tmp_path, runtime):
+    """The stream grace lease must NOT destroy a stream whose frame is
+    parked at an async stage longer than the grace period (reference
+    extends its lease per processed frame, ref pipeline.py:1425; here a
+    parked frame has no per-frame tick, so expiry re-checks in-flight
+    work) -- but a genuinely IDLE stream is still reaped."""
+    responses = queue.Queue()
+    pipeline = create_pipeline(
+        _two_stage_definition(tmp_path, params_b={"delay": 1.2}),
+        runtime=runtime)
+    stream = pipeline.create_stream_local(
+        "s", grace_time=0.4, queue_response=responses)
+    pipeline.create_frame_local(stream, {"value": 1})
+    # 1.2 s parked at stage b = three grace periods: previously the
+    # lease destroyed the stream mid-flight and the frame vanished.
+    assert run_until(runtime, lambda: not responses.empty(), timeout=15.0)
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    assert swag["value"] == 1
+    assert "s" in pipeline.streams          # survived its parked frame
+
+    # Now idle: the lease reaps it within ~2 grace periods.
+    assert run_until(runtime, lambda: "s" not in pipeline.streams,
+                     timeout=10.0), "idle stream was never reaped"
+    pipeline.stop()
+
+
+def test_grace_lease_stall_cap_reaps_wedged_frame(tmp_path, runtime):
+    """A frame parked at a stage that NEVER completes must not revive
+    the stream's grace lease forever: past the stall cap (10 grace
+    periods) the stream is reaped, frames and all."""
+    import importlib
+    pipeline_mod = importlib.import_module(
+        "aiko_services_tpu.pipeline.pipeline")
+    responses = queue.Queue()
+    pipeline = create_pipeline(
+        _two_stage_definition(tmp_path, cls_b="NeverComplete"),
+        runtime=runtime)
+    stream = pipeline.create_stream_local(
+        "s", grace_time=0.1, queue_response=responses)
+    pipeline.create_frame_local(stream, {"value": 1})
+    cap = 0.1 * pipeline_mod._STALL_REAP_FACTOR          # 1 s
+    assert run_until(runtime, lambda: "s" not in pipeline.streams,
+                     timeout=cap + 5.0), \
+        "wedged stream was never reaped past the stall cap"
     pipeline.stop()
 
 
