@@ -203,7 +203,10 @@ class LLM(PipelineElement):
     int8: halves decode's HBM stream), ``decode_block`` (fuse N decode
     steps per device dispatch: amortizes host round trips), ``inflight``
     (keep N fused blocks in flight, chained device-side: hides the
-    dispatch round trip behind device compute).
+    dispatch round trip behind device compute), ``max_slots`` (device
+    batch width: size to the expected concurrent-frame count; decode is
+    weight-HBM-bound at short context, so wider batches decode more
+    frames' requests per block at nearly the same step time).
 
     ASYNC by default: each frame submits its request to the shared
     :class:`ContinuousBatcher` and parks; the batcher pump rides the
@@ -274,9 +277,12 @@ class LLM(PipelineElement):
                 f"quantize={quantize!r}: use true/false or int8")
         decode_block, _ = self.get_parameter("decode_block", 1)
         inflight, _ = self.get_parameter("inflight", 2)
+        # Requests beyond max_slots queue (sizing rationale: class
+        # docstring).
+        max_slots, _ = self.get_parameter("max_slots", 8)
         self._batcher = ContinuousBatcher(
-            params, config, decode_block=int(decode_block),
-            inflight=int(inflight))
+            params, config, max_slots=int(max_slots),
+            decode_block=int(decode_block), inflight=int(inflight))
 
     def _make_request(self, stream, text) -> tuple[Request, list[int]]:
         max_new, _ = self.get_parameter("max_new_tokens", 32)

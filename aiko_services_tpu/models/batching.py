@@ -53,6 +53,12 @@ from ..utils.misc import next_power_of_two
 
 __all__ = ["Request", "ContinuousBatcher"]
 
+# Batched admission advances at most this many slots per tick: compile
+# buckets stay {1, 2, 4, 8} regardless of max_slots (an [8*chunk, dim]
+# prefill matmul already feeds the MXU; wider bursts would only add
+# power-of-two compile shapes, each a fresh jit of the full model).
+_ADMISSION_BURST_MAX = 8
+
 
 @dataclasses.dataclass
 class Request:
@@ -209,6 +215,10 @@ class ContinuousBatcher:
             slot = self._prefilling.pop(0)
             if self.slots[slot] is not None:    # else: cancelled
                 admitting.append(slot)
+        # Overflow waits one tick (FIFO rotation keeps chunk fairness);
+        # see _ADMISSION_BURST_MAX for why the burst is capped.
+        self._prefilling.extend(admitting[_ADMISSION_BURST_MAX:])
+        admitting = admitting[:_ADMISSION_BURST_MAX]
         if not admitting:
             return
         n = len(admitting)

@@ -741,9 +741,15 @@ def bench_pipeline_e2e() -> dict:
                     # the whole 32-token budget in one block the
                     # pipeline holds only one block in flight per wave,
                     # so retires cannot overlap the next dispatch.
+                    # max_slots=24: every in-flight frame's request
+                    # decodes in ONE device batch (decode is
+                    # weight-HBM-bound at 512 ctx, so 24 rows cost
+                    # nearly the same per step as 8) -- one wave of
+                    # fused blocks instead of three.
                     {"model": "llama3-1b", "max_seq": 512,
                      "quantize": "int8", "decode_block": 16,
-                     "inflight": 3, "max_new_tokens": 32},
+                     "inflight": 3, "max_new_tokens": 32,
+                     "max_slots": E2E_FRAMES},
                     module="aiko_services_tpu.elements.llm"),
         ]}
     pipeline = Pipeline(definition, runtime=runtime)

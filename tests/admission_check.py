@@ -1,7 +1,17 @@
 """Standalone batched-vs-single admission equality check (run by
 test_models.py::test_batched_admission_matches_single in a SUBPROCESS --
 see that test's docstring for why).  Exits 0 on success, 1 with a
-diagnostic on mismatch."""
+diagnostic on mismatch.
+
+Determinism (round 5): the exact-stream comparison requires the
+[N*S, dim] batched prefill GEMM and the [S, dim] single-slot GEMM to
+round IDENTICALLY.  With multi-threaded Eigen GEMMs the partitioning --
+and therefore the summation order -- varies with machine load, which
+flips near-tie argmaxes intermittently (~1-in-7 under a loaded host;
+reproduced round 5 in fresh processes, so this, not cross-test buffer
+state, was the flake's root cause).  Single-threaded GEMMs + highest
+matmul precision make both shapes round identically run-to-run
+(0 failures across repeated loaded-host trials)."""
 
 import os
 import pathlib
@@ -9,9 +19,12 @@ import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_cpu_multi_thread_eigen=false").strip()
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np
 

@@ -81,3 +81,36 @@ def test_llm_pipeline_element(runtime, tmp_path):
     assert okay, diagnostic
     assert isinstance(swag["text"], str)
     pipeline.stop()
+
+
+def test_llm_element_max_slots_parameter(runtime, tmp_path):
+    """``max_slots`` sizes the element's device batch; requests beyond
+    it queue and still all complete."""
+    definition = {
+        "version": 0, "name": "llm_slots", "runtime": "jax",
+        "graph": ["(llm)"],
+        "elements": [{
+            "name": "llm",
+            "input": [{"name": "text"}],
+            "output": [{"name": "text"}],
+            "parameters": {"max_new_tokens": 4, "max_seq": 64,
+                           "max_slots": 3},
+            "deploy": {"local": {
+                "module": "aiko_services_tpu.elements.llm",
+                "class_name": "LLM"}}}]}
+    path = tmp_path / "llm.json"
+    path.write_text(json.dumps(definition))
+
+    import queue
+    responses = queue.Queue()
+    pipeline = create_pipeline(str(path), runtime=runtime)
+    stream = pipeline.create_stream_local("1", queue_response=responses)
+    for i in range(5):                         # 5 requests, 3 slots
+        pipeline.create_frame_local(stream, {"text": f"hi {i}"})
+    assert run_until(runtime, lambda: responses.qsize() >= 5,
+                     timeout=120.0)
+    while not responses.empty():
+        *_, okay, diagnostic = responses.get()
+        assert okay, diagnostic
+    assert pipeline.graph.get_node("llm").element._batcher.max_slots == 3
+    pipeline.stop()

@@ -279,6 +279,34 @@ def test_decode_block_matches_single_steps(tiny):
     assert [len(v) for v in blocked.values()] == [5, 9, 4]
 
 
+def test_batched_admission_burst_capped(tiny):
+    """Batched admission advances at most _ADMISSION_BURST_MAX slots per
+    tick: compile buckets stay {1,2,4,8} for ANY max_slots (a wide
+    max_slots must not introduce 16/32-row prefill compile shapes),
+    with the overflow admitted on following ticks -- every request
+    still completes."""
+    from aiko_services_tpu.models.batching import _ADMISSION_BURST_MAX
+
+    config, params = tiny
+    tok = ByteTokenizer()
+    out: dict = {}
+    batcher = ContinuousBatcher(params, config, max_slots=20, max_seq=64,
+                                prefill_chunk=16, decode_block=4,
+                                inflight=2)
+    for i in range(20):
+        batcher.submit(Request(f"r{i}", tok.encode(f"burst {i}"),
+                               max_new_tokens=20,
+                               emit=lambda r, t, f:
+                               out.setdefault(r, []).append(t)))
+    for expected in (8, 16, 20):         # one burst of <= 8 per tick
+        batcher.step()
+        assert int(np.sum(batcher.decoding)) == expected
+    steps = batcher.run_until_drained(max_steps=300)
+    assert steps < 300
+    assert len(out) == 20
+    assert all(len(tokens) == 20 for tokens in out.values())
+
+
 def test_cancel_frees_slot_and_stops_emits(tiny):
     """ADVICE r4: cancel() removes a queued request, frees an admitted
     request's slot immediately, and suppresses every later emit for it
@@ -362,10 +390,13 @@ def test_batched_admission_matches_single():
     intermittently CORRUPTED by an earlier interpret-mode int8 Pallas
     test (bisected to test_flash_decode.py::
     test_flash_int8_matches_dequantized_dense; whole cache rows read
-    back wrong by >3.0) while 30 fresh-process trials are
-    bit-identical -- a jax-0.9 CPU-backend buffer interaction, not
-    framework logic.  Subprocess isolation keeps the check meaningful
-    AND deterministic."""
+    back wrong by >3.0) -- a jax-0.9 CPU-backend buffer interaction,
+    not framework logic.  The check itself additionally pins
+    single-threaded GEMMs + highest matmul precision: round 5 found
+    fresh processes ALSO flaked ~1-in-7 on a loaded host, because
+    multi-threaded Eigen partitioning varies with load and flips
+    near-tie argmaxes between the two admission shapes (see
+    admission_check.py's docstring)."""
     import pathlib
     import subprocess
     import sys as _sys
