@@ -32,6 +32,8 @@ Here serving is native to the framework:
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 
 import jax
 
@@ -208,12 +210,16 @@ class LLM(PipelineElement):
     weight-HBM-bound at short context, so wider batches decode more
     frames' requests per block at nearly the same step time).
 
-    ASYNC by default: each frame submits its request to the shared
-    :class:`ContinuousBatcher` and parks; the batcher pump rides the
-    event engine, so decode ticks interleave with message handling and
-    with OTHER frames' stages -- requests from many in-flight
+    ASYNC by default: each frame parks and its request hops to the
+    element's device WORKER THREAD, which owns the model and the shared
+    :class:`ContinuousBatcher` -- model build (minutes of jit compiles
+    for a 1B model through a congested link), admission, the decode
+    loop, and the retire fetches all run OFF the event loop, so they
+    never block other stages' frames (detect of frame k+1 proceeds
+    while the LLM compiles or decodes).  Requests from many in-flight
     frames/streams decode together in one device batch (continuous
-    batching across frames, not per-frame drains).  Set parameter
+    batching across frames, not per-frame drains); completions post
+    back through the engine's thread-safe continuation.  Set parameter
     ``synchronous: true`` for the blocking per-frame path.
     """
 
@@ -223,26 +229,55 @@ class LLM(PipelineElement):
         super().__init__(context)
         self._batcher: ContinuousBatcher | None = None
         self._tokenizer = None
-        self._pumping = False
         self._request_seq = 0
         # request_id -> complete for parked async frames, so a failing
-        # pump can error them out instead of leaving them parked.
+        # worker can error them out instead of leaving them parked.
+        # Owned by the WORKER thread (cancels arrive via the queue).
         self._completes: dict = {}
+        # ("request", stream_id, text, complete, request_params,
+        # model_params) | ("cancel", prefix); created lazily with the
+        # daemon worker thread.
+        self._work: queue.Queue | None = None
+        # Serializes device access between the worker and the blocking
+        # process_frame path (a per-stream ``synchronous: true`` can
+        # run while another stream uses the async worker).
+        self._device_lock = threading.RLock()
 
-    def _ensure_model(self):
+    # Model-config parameters, resolved ON THE EVENT LOOP (stream
+    # parameter precedence reads the pipeline's current-stream context,
+    # which only the loop thread maintains) and shipped to the worker.
+    _MODEL_PARAMS = ("checkpoint", "tokenizer", "vocab_size", "max_seq",
+                     "seed", "attention", "model", "quantize",
+                     "decode_block", "inflight", "max_slots")
+
+    def _resolve_model_params(self) -> dict:
+        resolved = {}
+        for name in self._MODEL_PARAMS:
+            value, found = self.get_parameter(name, None)
+            if found and value is not None:
+                resolved[name] = value
+        return resolved
+
+    def _resolve_request_params(self) -> dict:
+        max_new, _ = self.get_parameter("max_new_tokens", 32)
+        temperature, _ = self.get_parameter("temperature", 0.0)
+        system_prompt, _ = self.get_parameter("system_prompt", "")
+        return {"max_new_tokens": int(max_new),
+                "temperature": float(temperature),
+                "system_prompt": str(system_prompt or "")}
+
+    def _ensure_model(self, settings: dict | None = None):
         if self._batcher is not None:
             return
-        checkpoint, _ = self.get_parameter("checkpoint", None)
-        tokenizer_path, found = self.get_parameter("tokenizer", None)
+        if settings is None:
+            settings = self._resolve_model_params()
+        tokenizer_path = settings.get("tokenizer")
         self._tokenizer = load_tokenizer(tokenizer_path) \
-            if found and tokenizer_path else ByteTokenizer()
-        vocab, vocab_found = self.get_parameter("vocab_size", None)
-        max_seq, _ = self.get_parameter("max_seq", 256)
-        seed, _ = self.get_parameter("seed", 0)
+            if tokenizer_path else ByteTokenizer()
+        vocab = settings.get("vocab_size")
         # "flash" routes chunked admission through the Pallas kernel --
         # the long-context setting (2.5x dense at 8k on v5e).
-        attention, _ = self.get_parameter("attention", "dense")
-        model, _ = self.get_parameter("model", "tiny")
+        model = settings.get("model", "tiny")
         bases = {"tiny": llama.LlamaConfig.tiny,
                  "tiny-moe": llama.LlamaConfig.tiny_moe,
                  "llama3-1b": llama.LlamaConfig.llama3_1b,
@@ -253,17 +288,19 @@ class LLM(PipelineElement):
         # An explicit vocab_size always wins (it must match the
         # tokenizer/checkpoint); otherwise tiny configs follow the
         # tokenizer and the llama configs keep their own vocab.
-        if vocab_found and vocab is not None:
+        if vocab is not None:
             base = dataclasses.replace(base, vocab_size=int(vocab))
         elif str(model).startswith("tiny"):
             base = dataclasses.replace(
                 base, vocab_size=self._tokenizer.vocab_size)
-        config = dataclasses.replace(base, max_seq=int(max_seq),
-                                     attention=str(attention))
+        config = dataclasses.replace(
+            base, max_seq=int(settings.get("max_seq", 256)),
+            attention=str(settings.get("attention", "dense")))
         params = _restore(
-            llama.init_params(jax.random.PRNGKey(int(seed)), config),
-            checkpoint)
-        quantize, _ = self.get_parameter("quantize", False)
+            llama.init_params(
+                jax.random.PRNGKey(int(settings.get("seed", 0))), config),
+            settings.get("checkpoint"))
+        quantize = settings.get("quantize", False)
         normalized = str(quantize).strip().lower()
         if parse_bool(quantize) or normalized == "int8":
             # Weight-only int8 (models/quant.py): halves decode's HBM
@@ -275,96 +312,138 @@ class LLM(PipelineElement):
             # decode rate.
             raise ValueError(
                 f"quantize={quantize!r}: use true/false or int8")
-        decode_block, _ = self.get_parameter("decode_block", 1)
-        inflight, _ = self.get_parameter("inflight", 2)
         # Requests beyond max_slots queue (sizing rationale: class
         # docstring).
-        max_slots, _ = self.get_parameter("max_slots", 8)
         self._batcher = ContinuousBatcher(
-            params, config, max_slots=int(max_slots),
-            decode_block=int(decode_block), inflight=int(inflight))
+            params, config,
+            max_slots=int(settings.get("max_slots", 8)),
+            decode_block=int(settings.get("decode_block", 1)),
+            inflight=int(settings.get("inflight", 2)))
 
-    def _make_request(self, stream, text) -> tuple[Request, list[int]]:
-        max_new, _ = self.get_parameter("max_new_tokens", 32)
-        temperature, _ = self.get_parameter("temperature", 0.0)
-        system_prompt, _ = self.get_parameter("system_prompt", "")
+    def _make_request(self, stream_id, text,
+                      request_params: dict) -> tuple[Request, list[int]]:
+        system_prompt = request_params["system_prompt"]
         prompt = f"{system_prompt}{text}" if system_prompt else str(text)
         self._request_seq += 1
         collected: list[int] = []
         return Request(
-            request_id=f"{stream.stream_id}/{self._request_seq}",
+            request_id=f"{stream_id}/{self._request_seq}",
             prompt_tokens=self._tokenizer.encode(prompt),
-            max_new_tokens=int(max_new), temperature=float(temperature),
+            max_new_tokens=request_params["max_new_tokens"],
+            temperature=request_params["temperature"],
             eos_tokens=self._tokenizer.eos_tokens,
             emit=_collector(self._tokenizer, collected)), collected
 
     def process_frame_start(self, stream, complete, text=None, **inputs):
-        self._ensure_model()
-        request, collected = self._make_request(stream, text)
-        tokenizer, inner_emit = self._tokenizer, request.emit
-
-        def emit(request_id, token, finished):
-            inner_emit(request_id, token, finished)
-            if finished:
-                self._completes.pop(request_id, None)
-                complete(StreamEvent.OKAY,
-                         {"text": tokenizer.decode(collected)})
-
-        request.emit = emit
-        self._completes[request.request_id] = complete
-        self._batcher.submit(request)
-        self._start_pump()
+        self._start_worker()
+        # Parameters resolve HERE (loop thread, current-stream context
+        # intact); the worker consumes pre-resolved values.  The model
+        # settings ride along until the first request builds it.
+        model_params = None if self._batcher is not None \
+            else self._resolve_model_params()
+        self._work.put(("request", str(stream.stream_id), text, complete,
+                        self._resolve_request_params(), model_params))
 
     def stop_stream(self, stream, stream_id):
         """Cancel the stream's outstanding requests: a frame parked here
         when its stream is destroyed must stop decoding (it would
         otherwise run to max_new_tokens in a device batch slot) and its
-        parked ``complete`` must not fire later."""
-        prefix = f"{stream.stream_id}/"
-        for request_id in [rid for rid in self._completes
-                           if str(rid).startswith(prefix)]:
-            self._completes.pop(request_id, None)
-            if self._batcher is not None:
-                self._batcher.cancel(request_id)
+        parked ``complete`` must not fire later.  Routed through the
+        worker queue -- the batcher and the completes registry are
+        worker-owned."""
+        if self._work is not None:
+            self._work.put(("cancel", f"{stream.stream_id}/"))
         return StreamEvent.OKAY, {}
 
-    def _start_pump(self):
-        if not self._pumping:
-            self._pumping = True
-            self.pipeline.runtime.engine.post_deferred(self._pump)
+    # -- device worker -----------------------------------------------------
 
-    def _pump(self):
-        batcher = self._batcher
-        if batcher is None:             # stopped mid-flight
-            self._pumping = False
-            return
-        try:
-            batcher.step()
-        except Exception as error:
-            # A decode tick failing (device error, bad checkpoint
-            # shapes) must FAIL the parked frames, not silently stop
-            # the pump with them parked forever -- the async analogue
-            # of the engine's per-element try/except.
-            self.logger.exception("LLM pump step failed")
-            self._pumping = False
-            completes, self._completes = self._completes, {}
-            for complete in completes.values():
+    def _start_worker(self):
+        if self._work is None:
+            self._work = queue.Queue()
+            threading.Thread(target=self._worker, args=(self._work,),
+                             daemon=True,
+                             name=f"llm-worker-{self.name}").start()
+
+    def _handle(self, item):
+        """One queue item, on the worker thread.  A failing REQUEST
+        (bad model parameter, broken checkpoint) errors ITS OWN frame
+        and is swallowed -- one bad frame must not strand the others."""
+        if item[0] == "request":
+            _, stream_id, text, complete, request_params, model_params \
+                = item
+            try:
+                self._ensure_model(model_params)
+                request, collected = self._make_request(
+                    stream_id, text, request_params)
+            except Exception as error:
+                self.logger.exception("LLM request setup failed")
                 complete(StreamEvent.ERROR,
-                         {"diagnostic": f"llm decode failed: {error}"})
-            return
-        if (batcher.active_count or batcher.queue_depth
-                or batcher.blocks_in_flight):
-            # Deferred so in-flight frames' submits land between decode
-            # ticks and batch together.
-            self.pipeline.runtime.engine.post_deferred(self._pump)
-        else:
-            self._pumping = False
+                         {"diagnostic": f"llm: {error}"})
+                return
+            tokenizer, inner_emit = self._tokenizer, request.emit
+
+            def emit(request_id, token, finished):
+                inner_emit(request_id, token, finished)
+                if finished:
+                    self._completes.pop(request_id, None)
+                    complete(StreamEvent.OKAY,
+                             {"text": tokenizer.decode(collected)})
+
+            request.emit = emit
+            self._completes[request.request_id] = complete
+            self._batcher.submit(request)
+        else:                           # ("cancel", stream prefix)
+            prefix = item[1]
+            for request_id in [rid for rid in self._completes
+                               if str(rid).startswith(prefix)]:
+                self._completes.pop(request_id, None)
+                if self._batcher is not None:
+                    self._batcher.cancel(request_id)
+
+    def _drain_work(self, work: "queue.Queue"):
+        while True:
+            try:
+                self._handle(work.get_nowait())
+            except queue.Empty:
+                return
+
+    def _worker(self, work: "queue.Queue"):
+        """Owns every device interaction: lazy model build, admission,
+        the decode loop, retire fetches.  Blocks on the queue while
+        idle; while decoding, new queue items (requests from frames
+        resumed meanwhile, stream cancels) are drained BETWEEN ticks so
+        they join the live device batch."""
+        while True:
+            item = work.get()
+            with self._device_lock:
+                try:
+                    self._handle(item)
+                    self._drain_work(work)
+                    batcher = self._batcher
+                    while batcher is not None and (
+                            batcher.active_count or batcher.queue_depth
+                            or batcher.blocks_in_flight):
+                        batcher.step()
+                        self._drain_work(work)
+                except Exception as error:
+                    # A failing decode tick must FAIL the parked frames,
+                    # not leave them parked forever -- the async
+                    # analogue of the engine's per-element try/except.
+                    self.logger.exception("LLM worker failed")
+                    completes, self._completes = self._completes, {}
+                    for complete in completes.values():
+                        complete(StreamEvent.ERROR,
+                                 {"diagnostic": f"llm worker: {error}"})
 
     def process_frame(self, stream, text=None, **inputs):
         """Blocking path (``synchronous: true`` or direct invocation):
-        drains the batcher inline."""
-        self._ensure_model()
-        request, collected = self._make_request(stream, text)
-        self._batcher.submit(request)
-        self._batcher.run_until_drained()
-        return StreamEvent.OKAY, {"text": self._tokenizer.decode(collected)}
+        drains the batcher inline, serialized against the async worker
+        through the device lock."""
+        with self._device_lock:
+            self._ensure_model()
+            request, collected = self._make_request(
+                str(stream.stream_id), text, self._resolve_request_params())
+            self._batcher.submit(request)
+            self._batcher.run_until_drained()
+            return StreamEvent.OKAY, {
+                "text": self._tokenizer.decode(collected)}
