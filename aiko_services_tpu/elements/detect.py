@@ -38,13 +38,12 @@ class Detector(TPUElement):
     frames submitted in one event-loop burst (up to ``max_batch``,
     default 8) detect together as a single [N, H, W, 3] dispatch
     (batch-8 is ~14x batch-1 on v5e), flushed when the engine's mailbox
-    drains so a lone frame pays no extra latency.  The jitted detect is
-    dispatched from the event loop (JAX dispatch is asynchronous) and
-    only the single host fetch per batch blocks -- on a fetch thread,
-    not the event loop.  Frame k+1's batch is therefore already on the
-    device queue while batch k's results copy back, and downstream
-    stages (LLM decode) overlap detect on the device.  Set parameter
-    ``synchronous: true`` for the blocking path.
+    drains so a lone frame pays no extra latency.  Batches hand off to
+    the element's fetch worker thread, which dispatches (including any
+    first-use jit compile) and fetches -- the event loop never blocks
+    on detect device work, so frame k+1's burst collects while batch
+    k runs and downstream stages (LLM decode) overlap detect on the
+    device.  Set parameter ``synchronous: true`` for the blocking path.
     """
 
     is_async = True
@@ -151,8 +150,13 @@ class Detector(TPUElement):
         self._flush()
 
     def _flush(self):
-        """Dispatch every pending frame as ONE batched detect per image
-        shape (batch padded up to a power-of-two compile bucket)."""
+        """Group every pending frame by (shape, dtype) -- stacking
+        float16 with float32 frames would silently promote, running
+        the narrower frame at a different precision than the blocking
+        path -- and hand the batches to the fetch worker.  Dispatch
+        (including a first-use jit compile, ~40 s through a congested
+        link) happens THERE, so the event loop never blocks on detect
+        device work and other stages' frames keep flowing."""
         pending, self._pending = self._pending, []
         if not pending or self._fetch_queue is None:
             for complete, image in pending:     # stopped mid-burst
@@ -167,13 +171,26 @@ class Detector(TPUElement):
                 complete(StreamEvent.ERROR,  # complete errors
                          {"diagnostic": f"bad image: {error}"})
                 continue
-            # Group by shape AND dtype: stacking float16 with float32
-            # frames would silently promote, running the narrower frame
-            # at a different precision than the blocking path.
             by_shape.setdefault(
                 (tuple(array.shape), str(array.dtype)), []).append(
                 (complete, image, array))
-        for group in by_shape.values():
+        if by_shape:
+            # The model is SNAPSHOTTED with the batch: on_replacement
+            # (mesh failure) nulls self._detect/_params on the event
+            # loop while batches may still be queued -- a queued batch
+            # must dispatch against the weights it was built with (or
+            # fail cleanly if those weights' devices died), never
+            # against a half-swapped model or a None.
+            self._fetch_queue.put(
+                (self._detect, self._params, list(by_shape.values())))
+
+    def _run_batches(self, detect, params, groups):
+        """Fetch-worker side of a flush: dispatch EVERY group first
+        (device work pipelines across groups), then fetch and complete
+        each.  A failing dispatch errors every frame of ITS group --
+        anything not completed here would stay parked forever."""
+        dispatched = []
+        for group in groups:
             try:
                 arrays = [array for _, _, array in group]
                 # Pad rows repeat the first image: idempotent compute,
@@ -181,33 +198,28 @@ class Detector(TPUElement):
                 # batch.
                 bucket = next_power_of_two(len(arrays))
                 arrays += [arrays[0]] * (bucket - len(arrays))
-                result = self._detect(self._params, jnp.stack(arrays))
+                result = detect(params, jnp.stack(arrays))
                 for leaf in jax.tree_util.tree_leaves(result):
                     if hasattr(leaf, "copy_to_host_async"):
                         leaf.copy_to_host_async()
             except Exception as error:
-                # A failing dispatch must ERROR every frame of ITS
-                # group -- pending was already cleared, so anything not
-                # completed here would stay parked forever (and on the
-                # drained-callback path the exception would otherwise
-                # vanish into the engine's handler log).
                 self.logger.exception("batched detect dispatch failed")
                 for complete, _, _ in group:
                     complete(StreamEvent.ERROR,
                              {"diagnostic": f"detect dispatch: {error}"})
                 continue
-            # Only the fetch blocks, and it blocks the fetch thread: the
-            # event loop is already free to dispatch the next batch.
-            self._fetch_queue.put(
-                ([(complete, image) for complete, image, _ in group],
-                 result))
+            dispatched.append((group, result))
+        for group, result in dispatched:
+            self._finish_batch(
+                [(complete, image) for complete, image, _ in group],
+                result)
 
     def _fetch_loop(self, fetch_queue):
         while True:
             item = fetch_queue.get()
             if item is None:          # drain-then-exit sentinel
                 return
-            self._finish_batch(*item)
+            self._run_batches(*item)
 
     def _stop_fetcher(self):
         """Retire the fetch thread (in-flight frames drain first); a

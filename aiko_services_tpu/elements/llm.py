@@ -429,9 +429,15 @@ class LLM(PipelineElement):
                     # A failing decode tick must FAIL the parked frames,
                     # not leave them parked forever -- the async
                     # analogue of the engine's per-element try/except.
+                    # Their requests are CANCELLED too: an errored
+                    # frame's request left active would keep decoding
+                    # to max_new_tokens in a device batch slot,
+                    # crowding out the next frames' requests.
                     self.logger.exception("LLM worker failed")
                     completes, self._completes = self._completes, {}
-                    for complete in completes.values():
+                    for request_id, complete in completes.items():
+                        if self._batcher is not None:
+                            self._batcher.cancel(request_id)
                         complete(StreamEvent.ERROR,
                                  {"diagnostic": f"llm worker: {error}"})
 
