@@ -4,7 +4,7 @@ from .scheme_zmq import (DataSchemeZMQ, TextReadZMQ, TextWriteZMQ,
                          ImageReadZMQ, ImageWriteZMQ)
 from .scheme_tty import DataSchemeTTY, TextReadTTY, TextWriteTTY
 from .text import (TextReadFile, TextWriteFile, TextTransform, TextSample,
-                   TextOutput)
+                   TextFilter, TextOutput)
 from .image import (ImageReadFile, ImageWriteFile, ImageResize,
                     ImageOverlay, ImageOutput, image_to_array,
                     array_to_image)

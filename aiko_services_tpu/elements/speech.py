@@ -119,10 +119,18 @@ class ASR(PipelineElement):
                     endpoint_silence=float(endpoint) if endpoint
                     else None)
                 self._streamers[stream.stream_id] = streamer
+            finalized_before = streamer.chunks_transcribed
             text = streamer.push(samples)
+            # utterance_end marks the EVENT (a chunk filled or the
+            # endpoint fired), independent of whether the decoded text
+            # is empty -- downstream gates (TextFilter gate:
+            # utterance_end) trigger on utterance boundaries, not on
+            # what the model happened to emit.
             return StreamEvent.OKAY, {
                 "text": text, "partial_text": streamer.partial_text,
-                "stable_text": streamer.stable_text}
+                "stable_text": streamer.stable_text,
+                "utterance_end":
+                    streamer.chunks_transcribed > finalized_before}
         chunk = int(config.sample_rate * config.chunk_seconds)
         true_rows = max(1, -(-len(samples) // chunk))
         rows = _chunk_rows(samples, chunk, self._bucketer)

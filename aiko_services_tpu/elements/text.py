@@ -11,7 +11,7 @@ from ..pipeline import (DataSource, DataTarget, PipelineElement,
 from .scheme_file import DataSchemeFile
 
 __all__ = ["TextReadFile", "TextWriteFile", "TextTransform", "TextSample",
-           "TextOutput"]
+           "TextFilter", "TextOutput"]
 
 
 class TextReadFile(DataSource):
@@ -65,6 +65,38 @@ class TextTransform(PipelineElement):
             return StreamEvent.ERROR, {
                 "diagnostic": f"unknown transform {name!r}"}
         return StreamEvent.OKAY, {"text": transform(str(text))}
+
+
+class TextFilter(PipelineElement):
+    """Gates frames on content: drops frames whose ``text`` is empty or
+    whitespace, or -- with parameter ``gate`` naming another input --
+    frames where THAT input is falsy.  The streaming-speech use:
+    ``gate: utterance_end`` passes only the frames where the ASR
+    finalized an utterance, so per-hop partial frames never reach a
+    downstream LLM stage (the reference's speech pipelines likewise act
+    on whisper's completed segments, speech_elements.py:53-84)."""
+
+    @staticmethod
+    def _truthy(value) -> bool:
+        if value is None:
+            return False
+        if isinstance(value, str):
+            return bool(value.strip())
+        size = getattr(value, "size", None)     # numpy/jax arrays: no
+        if size is not None:                    # ambiguous bool()
+            return int(size) > 0
+        return bool(value)
+
+    def process_frame(self, stream, text=None, **inputs):
+        gate, found = self.get_parameter("gate", None)
+        if found and gate:
+            # 'text' binds to the named parameter, never **inputs.
+            value = text if str(gate) == "text" else inputs.get(str(gate))
+        else:
+            value = text
+        if not self._truthy(value):
+            return StreamEvent.DROP_FRAME, {}
+        return StreamEvent.OKAY, {"text": text}
 
 
 class TextSample(PipelineElement):

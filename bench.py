@@ -988,6 +988,136 @@ def bench_asr(rtt: float) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 6. Speech pipeline end-to-end (BASELINE config 5): live audio hops ->
+#    streaming ASR -> utterance gate -> LLM response, through the REAL
+#    engine -- the multimodal streaming composition, measured as the
+#    per-hop transcription latency and the utterance-end -> LLM-response
+#    latency.
+
+SPEECH_UTTERANCES = 3
+
+
+def bench_speech_e2e() -> dict:
+    import numpy as np
+    from aiko_services_tpu.pipeline import Pipeline
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.transport import reset_broker
+
+    reset_broker()
+    reset_process()
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    rate = 16000
+    hop = rate                                  # 1 s hops
+    rng = np.random.default_rng(0)
+    speech_hop = (rng.standard_normal(hop) * 0.3).astype(np.float32)
+    silence_hop = np.zeros(hop, dtype=np.float32)
+
+    asr_params = {"model_size": "base", "streaming": True,
+                  "hop_seconds": 1.0, "endpoint_silence": 0.5}
+    definition = {
+        "version": 0, "name": "bench_speech", "runtime": "jax",
+        "graph": ["(ASR (GATE (LLM)))"], "parameters": {},
+        "elements": [
+            element("ASR", "ASR", ["audio", "sample_rate"],
+                    ["text", "partial_text", "utterance_end"],
+                    asr_params,
+                    module="aiko_services_tpu.elements.speech"),
+            # Only utterance-END frames reach the LLM; per-hop partial
+            # frames drop here (the reference's speech pipelines act on
+            # whisper's completed segments the same way).
+            element("GATE", "TextFilter", ["text", "utterance_end"],
+                    ["text"], {"gate": "utterance_end"},
+                    module="aiko_services_tpu.elements.text"),
+            element("LLM", "LLM", ["text"], ["text"],
+                    {"model": "llama3-1b", "max_seq": 512,
+                     "quantize": "int8", "decode_block": 16,
+                     "inflight": 3, "max_new_tokens": 32},
+                    module="aiko_services_tpu.elements.llm"),
+        ]}
+    pipeline = Pipeline(definition, runtime=runtime)
+    responses: "queue.Queue" = queue.Queue()
+
+    def push(samples):
+        pipeline.process_frame_local(
+            {"audio": samples, "sample_rate": rate},
+            stream_id="speech", queue_response=responses)
+
+    def await_response(timeout):
+        runtime.run(until=lambda: not responses.empty(), timeout=timeout)
+        if responses.empty():
+            return None
+        *_, okay, diagnostic = responses.get()
+        return okay, diagnostic
+
+    # Warmup utterance: compiles the batch-1 ASR window and (unless the
+    # e2e section already compiled them in-process) the LLM shapes.
+    for _ in range(3):
+        push(speech_hop)
+    push(silence_hop)
+    warm = await_response(1800.0)
+    if warm is None or not warm[0]:
+        runtime.terminate()
+        return {"speech_e2e_error":
+                f"warmup failed: {warm[1] if warm else 'timeout'}"}
+
+    # Per-hop transcription latency: the streaming ASR decodes the
+    # padded window every hop; gated frames DROP, so time each speech
+    # hop through the engine on a second, gate-free stream.
+    solo = Pipeline({
+        "version": 0, "name": "bench_speech_solo", "runtime": "jax",
+        "graph": ["(ASR)"], "parameters": {},
+        "elements": [element(
+            "ASR", "ASR", ["audio", "sample_rate"],
+            ["text", "partial_text", "utterance_end"], asr_params,
+            module="aiko_services_tpu.elements.speech")]},
+        runtime=runtime)
+    solo_responses: "queue.Queue" = queue.Queue()
+    hop_times = []
+    for index in range(6):
+        start = time.perf_counter()
+        solo.process_frame_local(
+            {"audio": speech_hop, "sample_rate": rate},
+            stream_id="solo", queue_response=solo_responses)
+        runtime.run(until=lambda: not solo_responses.empty(),
+                    timeout=120.0)
+        if solo_responses.empty():
+            break
+        solo_responses.get()
+        if index:                       # first hop pays residual warmup
+            hop_times.append(time.perf_counter() - start)
+
+    # Utterance -> response: 3 speech hops, then the silence hop whose
+    # endpoint finalizes the utterance and wakes the LLM.  The pumps
+    # are non-blocking posts, so the measured window covers the queued
+    # hops' decodes + the endpoint flush + the 32-token generation.
+    endpoint_times = []
+    for _ in range(SPEECH_UTTERANCES):
+        for _ in range(3):
+            push(speech_hop)
+        endpoint_start = time.perf_counter()
+        push(silence_hop)
+        reply = await_response(600.0)
+        if reply is None or not reply[0]:
+            runtime.terminate()
+            return {"speech_e2e_error":
+                    f"utterance failed: {reply[1] if reply else 'timeout'}"}
+        endpoint_times.append(time.perf_counter() - endpoint_start)
+    runtime.terminate()
+
+    def p50(values):
+        return sorted(values)[len(values) // 2] if values else None
+
+    result = {"speech_e2e_utterances": SPEECH_UTTERANCES,
+              "speech_e2e_hop_seconds": 1.0}
+    if hop_times:
+        result["speech_e2e_hop_p50_ms"] = round(p50(hop_times) * 1000, 1)
+    result["speech_e2e_utterance_to_response_p50_ms"] = round(
+        p50(endpoint_times) * 1000, 1)
+    return result
+
+
+# ---------------------------------------------------------------------------
 
 def main() -> int:
     logging.disable(logging.WARNING)
@@ -1010,7 +1140,8 @@ def main() -> int:
             ("bench_detect", lambda: bench_detect(peak, rtt)),
             ("bench_llm", lambda: bench_llm(peak, rtt)),
             ("bench_pipeline_e2e", bench_pipeline_e2e),
-            ("bench_asr", lambda: bench_asr(rtt))):
+            ("bench_asr", lambda: bench_asr(rtt)),
+            ("bench_speech_e2e", bench_speech_e2e)):
         try:
             record.update(section())
         except Exception as error:          # keep the other sections

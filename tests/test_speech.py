@@ -152,3 +152,103 @@ def test_asr_rejects_wrong_rate(runtime):
     _, _, _, _, okay, diagnostic = responses.get()
     assert not okay
     assert "16000" in diagnostic
+
+
+def test_streaming_asr_gated_speech_pipeline(runtime):
+    """The config-5 streaming composition: audio hops -> streaming ASR
+    (hop partials, endpoint finalization, the new ``utterance_end``
+    output) -> TextFilter gate -> downstream stage.  Per-hop frames
+    DROP at the gate; exactly the utterance-end frame passes."""
+    import tests_media_helpers
+    collected = []
+    tests_media_helpers.SINK = collected
+
+    pipeline = Pipeline(definition(
+        ["(Asr (Gate (Collect)))"],
+        [element("Asr", "ASR", ["audio", "sample_rate"],
+                 ["text", "partial_text", "utterance_end"],
+                 # tiny config has a 1.0 s chunk; 0.25 s hops keep the
+                 # 0.75 s utterance BELOW chunk-fill so the silence
+                 # hop's ENERGY ENDPOINT is the only finalizer -- the
+                 # mechanism under test.
+                 {"model_size": "tiny", "streaming": True,
+                  "hop_seconds": 0.25, "endpoint_silence": 0.25}),
+         element("Gate", "TextFilter", ["text", "utterance_end"],
+                 ["text"], {"gate": "utterance_end"}),
+         {"name": "Collect", "input": [{"name": "text"}], "output": [],
+          "deploy": {"local": {"module": "tests_media_helpers",
+                               "class_name": "CollectText"}},
+          "parameters": {}}],
+        name="p_speech_gate"), runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("s1", queue_response=responses)
+
+    rate = 16000
+    rng = np.random.default_rng(0)
+    hop = int(rate * 0.25)
+    speech = (rng.standard_normal(hop) * 0.3).astype(np.float32)
+    silence = np.zeros(hop, dtype=np.float32)
+    for samples in (speech, speech):
+        pipeline.create_frame_local(stream, {"audio": samples,
+                                             "sample_rate": rate})
+    # Speech hops alone never finalize (0.5 s < the 1 s chunk).
+    assert run_until(
+        runtime,
+        lambda: pipeline.graph.get_node("Asr").element._streamers
+        .get("s1") is not None
+        and pipeline.graph.get_node("Asr").element._streamers["s1"]
+        .partial_decodes >= 1, timeout=120.0)
+    assert len(collected) == 0
+    pipeline.create_frame_local(stream, {"audio": silence,
+                                         "sample_rate": rate})
+    # The silence hop's endpoint finalizes; ITS frame reaches Collect.
+    assert run_until(runtime, lambda: len(collected) >= 1, timeout=120.0)
+    assert len(collected) == 1
+    assert isinstance(collected[0], str)          # gated TEXT output
+    streamer = pipeline.graph.get_node("Asr").element._streamers["s1"]
+    assert streamer.chunks_transcribed == 1       # endpoint finalized
+    assert len(streamer._pending) == 0            # buffer flushed
+
+
+def test_text_filter_drops_empty_and_gates():
+    from aiko_services_tpu.elements.text import TextFilter
+    from aiko_services_tpu.pipeline import StreamEvent
+    from aiko_services_tpu.pipeline.element import ElementContext
+
+    class _FakePipeline:
+        def current_stream(self):
+            return None
+
+        def get_pipeline_parameter(self, name, default=None):
+            return default
+
+    drop_empty = TextFilter(ElementContext("f", None, _FakePipeline(), {}))
+    assert drop_empty.process_frame(None, text="  ")[0] \
+        == StreamEvent.DROP_FRAME
+    event, outputs = drop_empty.process_frame(None, text="hi")
+    assert event == StreamEvent.OKAY and outputs["text"] == "hi"
+
+    gated = TextFilter(ElementContext(
+        "f", None, _FakePipeline(), {"gate": "utterance_end"}))
+    assert gated.process_frame(None, text="hi", utterance_end=False)[0] \
+        == StreamEvent.DROP_FRAME
+    event, outputs = gated.process_frame(None, text="",
+                                         utterance_end=True)
+    assert event == StreamEvent.OKAY      # gate passes even empty text
+
+    # gate: text reaches the named parameter, not **inputs
+    gate_text = TextFilter(ElementContext(
+        "f", None, _FakePipeline(), {"gate": "text"}))
+    assert gate_text.process_frame(None, text="hi")[0] == StreamEvent.OKAY
+    assert gate_text.process_frame(None, text=" ")[0] \
+        == StreamEvent.DROP_FRAME
+
+    # array-valued gates must not raise on truthiness
+    gated_array = TextFilter(ElementContext(
+        "f", None, _FakePipeline(), {"gate": "detections"}))
+    event, _ = gated_array.process_frame(
+        None, text="x", detections=np.zeros((3, 4)))
+    assert event == StreamEvent.OKAY
+    assert gated_array.process_frame(
+        None, text="x", detections=np.zeros((0, 4)))[0] \
+        == StreamEvent.DROP_FRAME
