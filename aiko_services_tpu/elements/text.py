@@ -82,16 +82,28 @@ class TextFilter(PipelineElement):
             return False
         if isinstance(value, str):
             return bool(value.strip())
-        size = getattr(value, "size", None)     # numpy/jax arrays: no
-        if size is not None:                    # ambiguous bool()
-            return int(size) > 0
+        ndim = getattr(value, "ndim", None)     # numpy/jax values
+        if ndim is not None:
+            if ndim == 0:                       # scalar (np.bool_(False),
+                return bool(value)              # np.int64(0), ...)
+            return int(value.size) > 0          # real arrays: non-empty
         return bool(value)
 
     def process_frame(self, stream, text=None, **inputs):
         gate, found = self.get_parameter("gate", None)
         if found and gate:
             # 'text' binds to the named parameter, never **inputs.
-            value = text if str(gate) == "text" else inputs.get(str(gate))
+            if str(gate) == "text":
+                value = text
+            elif str(gate) in inputs:
+                value = inputs[str(gate)]
+            else:
+                # A typo'd/unwired gate must surface, not silently
+                # drop every frame forever.
+                return StreamEvent.ERROR, {
+                    "diagnostic": f"TextFilter gate {gate!r} is not an "
+                                  f"input of this frame "
+                                  f"(inputs: {sorted(inputs)})"}
         else:
             value = text
         if not self._truthy(value):

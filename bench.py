@@ -820,7 +820,7 @@ def bench_pipeline_e2e() -> dict:
                 best = (elapsed, list(collected))
         return best, error
 
-    best, error = timed_best_of(2, pump)
+    best, error = timed_best_of(3, pump)
     if best is None:
         runtime.terminate()
         return {"pipeline_e2e_error": error}
@@ -864,7 +864,7 @@ def bench_pipeline_e2e() -> dict:
 
     pump_device(E2E_WARMUP)
     runtime.run(until=lambda: drain(E2E_WARMUP), timeout=600.0)
-    device_best, device_error = timed_best_of(2, pump_device)
+    device_best, device_error = timed_best_of(3, pump_device)
     runtime.terminate()
     if device_best is None:
         result["pipeline_e2e_device_error"] = device_error

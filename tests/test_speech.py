@@ -252,3 +252,17 @@ def test_text_filter_drops_empty_and_gates():
     assert gated_array.process_frame(
         None, text="x", detections=np.zeros((0, 4)))[0] \
         == StreamEvent.DROP_FRAME
+    # numpy SCALARS gate on their value, not their size
+    assert gated_array.process_frame(
+        None, text="x", detections=np.bool_(False))[0] \
+        == StreamEvent.DROP_FRAME
+    assert gated_array.process_frame(
+        None, text="x", detections=np.int64(0))[0] \
+        == StreamEvent.DROP_FRAME
+    assert gated_array.process_frame(
+        None, text="x", detections=np.bool_(True))[0] \
+        == StreamEvent.OKAY
+    # a typo'd/unwired gate surfaces as an ERROR, not a silent drop
+    event, outputs = gated_array.process_frame(None, text="x")
+    assert event == StreamEvent.ERROR
+    assert "detections" in outputs["diagnostic"]
