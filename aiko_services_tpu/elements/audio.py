@@ -17,6 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..models.batching import MicroBatchElement, pad_to_bucket
 from ..pipeline import DataSource, DataTarget, PipelineElement, StreamEvent
 from .scheme_file import DataSchemeFile
 
@@ -68,6 +69,8 @@ class AudioReadFile(DataSource):
 
 class AudioWriteFile(DataTarget):
     """Writes ``audio`` to a WAV path (reference speech_elements.py:88)."""
+
+    host_inputs = ("audio",)    # sink: the engine fetches explicitly
 
     def process_frame(self, stream, audio=None, sample_rate=16000,
                       **inputs):
@@ -134,18 +137,58 @@ class AudioResampler(PipelineElement):
                                   "sample_rate": target}
 
 
-class AudioFFT(PipelineElement):
+class AudioFFT(MicroBatchElement, PipelineElement):
     """Magnitude spectrum per window of ``frames`` (reference
-    audio_io.py:299-334's PE_FFT)."""
+    audio_io.py:299-334's PE_FFT).
+
+    ASYNC by default: same-shape window batches parked here -- from
+    every stream -- transform together as one batched device FFT
+    (MicroBatcher), each frame's spectrum row staying device-resident
+    for downstream device stages.  ``synchronous: true`` for the
+    blocking path.
+    """
+
+    is_async = True
+    device_resident = True
+
+    @staticmethod
+    def _spectrum(frames):
+        mono = frames.mean(axis=-1) if frames.ndim >= 3 else frames
+        return jnp.abs(jnp.fft.rfft(mono.astype(jnp.float32), axis=-1))
 
     def process_frame(self, stream, frames=None, sample_rate=16000,
                       **inputs):
-        frames = jnp.asarray(frames)
-        mono = frames.mean(axis=-1) if frames.ndim == 3 else frames
-        spectrum = jnp.abs(jnp.fft.rfft(mono.astype(jnp.float32),
-                                        axis=-1))
-        return StreamEvent.OKAY, {"spectrum": spectrum,
-                                  "sample_rate": sample_rate}
+        return StreamEvent.OKAY, {
+            "spectrum": self._spectrum(jnp.asarray(frames)),
+            "sample_rate": sample_rate}
+
+    def process_frame_start(self, stream, complete, frames=None,
+                            sample_rate=16000, **inputs):
+        self.submit_microbatch(complete, (frames, sample_rate),
+                               diagnostic="bad frames")
+
+    def batch_key(self, payload):
+        frames, _ = payload
+        if not hasattr(frames, "shape"):    # array-likes: numpy metadata
+            frames = np.asarray(frames)
+        return tuple(frames.shape), str(frames.dtype)
+
+    def batch_run(self, context, key, payloads):
+        windows = pad_to_bucket([frames for frames, _ in payloads])
+        if all(isinstance(frames, np.ndarray) for frames in windows):
+            batch = jnp.asarray(np.stack(windows))  # one upload
+        else:
+            batch = jnp.stack([jnp.asarray(frames)
+                               for frames in windows])
+        # The leading batch dim shifts the mono check by one: a batch
+        # of [windows, window, C] items is 4-d.
+        mono = batch.mean(axis=-1) if batch.ndim >= 4 else batch
+        return jnp.abs(jnp.fft.rfft(mono.astype(jnp.float32), axis=-1))
+
+    def batch_finish(self, context, key, entries, result):
+        for row, (complete, (_, sample_rate)) in enumerate(entries):
+            complete(StreamEvent.OKAY, {"spectrum": result[row],
+                                        "sample_rate": sample_rate})
 
 
 class AudioGraphXY(PipelineElement):
@@ -161,6 +204,10 @@ class AudioGraphXY(PipelineElement):
     pixels), ``max_frequency`` (clip the x axis; default Nyquist).
     Outputs the plot as ``image`` and passes ``spectrum`` through.
     """
+
+    # numpy plotting is host work: one counted engine fetch, not an
+    # implicit sync of the device-resident AudioFFT output.
+    host_inputs = ("spectrum",)
 
     def process_frame(self, stream, spectrum=None, sample_rate=16000,
                       **inputs):

@@ -11,38 +11,40 @@ overlay dict the reference's elements feed ImageOverlay
 
 from __future__ import annotations
 
-import queue
-import threading
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..models import detector
+from ..models.batching import MicroBatchElement, pad_to_bucket
 from ..pipeline import StreamEvent, TPUElement
-from ..utils import next_power_of_two
 
 __all__ = ["Detector"]
 
 _DEFAULT_CLASSES = ["person", "robot_dog", "ball", "obstacle"]
 
 
-class Detector(TPUElement):
+class Detector(MicroBatchElement, TPUElement):
     """image [H, W, 3] uint8/float -> ``overlay`` rectangles +
     ``detections`` list.
 
     Parameters: ``num_classes``, ``class_names``, ``score_threshold``,
     ``checkpoint`` (optional orbax directory with {"params": ...}).
 
-    ASYNC by default: each frame parks and joins a MICRO-BATCH -- all
-    frames submitted in one event-loop burst (up to ``max_batch``,
-    default 8) detect together as a single [N, H, W, 3] dispatch
-    (batch-8 is ~14x batch-1 on v5e), flushed when the engine's mailbox
-    drains so a lone frame pays no extra latency.  Batches hand off to
-    the element's fetch worker thread, which dispatches (including any
-    first-use jit compile) and fetches -- the event loop never blocks
-    on detect device work, so frame k+1's burst collects while batch
-    k runs and downstream stages (LLM decode) overlap detect on the
+    ASYNC by default: each frame parks and joins a cross-stream
+    MICRO-BATCH (models/batching.py MicroBatcher) -- all frames
+    submitted in one event-loop burst, from every stream, detect
+    together as a single [N, H, W, 3] dispatch (batch-8 is ~14x batch-1
+    on v5e), flushed when the engine's mailbox drains so a lone frame
+    pays no extra latency.  Grouping keys on the PRE-UPLOAD image
+    signature, so a host-side burst stacks as ONE np.stack + ONE
+    host->device upload (uint8 bytes; the float conversion runs
+    batched on device) instead of a per-frame upload.  Batches hand
+    off to the MicroBatcher's worker thread, which dispatches
+    (including any first-use jit compile) and fetches the whole result
+    dict in ONE ``jax.device_get`` -- the event loop never blocks on
+    detect device work, so frame k+1's burst collects while batch k
+    runs and downstream stages (LLM decode) overlap detect on the
     device.  Set parameter ``synchronous: true`` for the blocking path.
     """
 
@@ -53,25 +55,16 @@ class Detector(TPUElement):
         self._params = None
         self._config = None
         self._detect = None
-        # Single DAEMON fetch worker (not a ThreadPoolExecutor: its
-        # non-daemon workers would outlive every stream and join at
-        # interpreter exit).  One thread per element for the element's
-        # lifetime; FIFO keeps frame completion ordered.
-        self._fetch_queue: queue.Queue | None = None
-        # Parked frames awaiting a MICRO-BATCHED dispatch: frames
-        # arriving in one event-loop burst detect together as one
-        # [N, H, W, 3] dispatch (batch-8 detect is ~14x batch-1 on v5e,
-        # BENCH_r04 detect_batch8_fps vs detect_fps).  Flushed when
-        # ``max_batch`` accumulate or when the engine's mailbox drains
-        # (post_deferred), so a lone frame is never delayed.
-        self._pending: list[tuple] = []
-        self._flush_scheduled = False
 
     def on_replacement(self):
         super().on_replacement()
+        # Flush queued batches against the OLD weights first (they
+        # dispatch against the snapshot they were built with, or fail
+        # cleanly if those weights' devices died), then retire the
+        # worker -- it referenced the old params.
+        self.stop_microbatcher()
         self._params = None             # _ensure_model reloads on the
         self._detect = None             # replacement submesh
-        self._stop_fetcher()            # old thread referenced old params
 
     def _ensure_model(self):
         if self._params is not None:
@@ -112,140 +105,79 @@ class Detector(TPUElement):
 
     @staticmethod
     def _preprocess(image):
-        """image -> [H, W, 3] float32 in [0, 1]."""
+        """image -> [H, W, 3] float32 in [0, 1] (device)."""
         array = jnp.asarray(image)
         if array.dtype == jnp.uint8:
             array = array.astype(jnp.float32) / 255.0
         return array[0] if array.ndim == 4 else array
 
+    def batch_key(self, image):
+        """Pre-upload grouping key: the RAW (shape, dtype) after the
+        leading batch-dim squeeze, computed from host metadata alone --
+        no device work at submit time.  Keying on the raw dtype keeps
+        normalization per-group correct (a uint8 group divides by 255
+        batched on device; a float group passes through); after
+        preprocessing both land on the same compiled float32 shape, so
+        splitting them costs no extra jit signature."""
+        if not hasattr(image, "shape"):
+            # Array-likes (nested lists) keyed via numpy metadata; the
+            # worker's jnp path converts the payload itself.
+            image = np.asarray(image)
+        shape = tuple(image.shape)
+        if len(shape) == 4:
+            shape = shape[1:]
+        return shape, str(image.dtype)
+
+    def batch_context(self):
+        # The model is SNAPSHOTTED with the flush: a queued batch must
+        # dispatch against the weights it was built with, never a
+        # half-swapped model after on_replacement.
+        return self._detect, self._params
+
     def _dispatch(self, image):
         """Enqueue the jitted detect (asynchronous on the device)."""
         return self._detect(self._params, self._preprocess(image)[None])
 
+    # -- async micro-batched path ------------------------------------------
+
     def process_frame_start(self, stream, complete, image=None, **inputs):
         self._ensure_model()
-        if self._fetch_queue is None:
-            self._fetch_queue = queue.Queue()
-            threading.Thread(target=self._fetch_loop,
-                             args=(self._fetch_queue,), daemon=True,
-                             name=f"detect-fetch-{self.name}").start()
-        max_batch, _ = self.get_parameter("max_batch", 8)
-        self._pending.append((complete, image))
-        if len(self._pending) >= int(max_batch):
-            self._flush()
-        elif not self._flush_scheduled:
-            # Flush once the engine's mailboxes drain: every frame
-            # submitted in this burst (frames queued behind this one,
-            # frames resumed by an upstream stage this tick) joins the
-            # same batched dispatch; a lone frame flushes immediately
-            # after -- no timer, no added latency.  (post_deferred
-            # would fire after ONE mailbox item, splitting the burst
-            # into batch-1 dispatches.)
-            self._flush_scheduled = True
-            self.pipeline.runtime.engine.post_when_drained(
-                self._flush_deferred)
+        self.submit_microbatch(complete, image, diagnostic="bad image")
 
-    def _flush_deferred(self):
-        self._flush_scheduled = False
-        self._flush()
+    def batch_run(self, context, key, images):
+        """Worker side: stack one same-signature group and dispatch.
+        An all-host group stacks ONCE on host (uint8 bytes upload raw;
+        the /255 float conversion runs batched on device); groups with
+        device-resident frames stack on device."""
+        detect, params = context
+        images = pad_to_bucket(images)
+        if all(isinstance(image, np.ndarray) for image in images):
+            batch = jnp.asarray(np.stack(
+                [image[0] if image.ndim == 4 else image
+                 for image in images]))
+            if batch.dtype == jnp.uint8:
+                batch = batch.astype(jnp.float32) / 255.0
+        else:
+            batch = jnp.stack([self._preprocess(image)
+                               for image in images])
+        result = detect(params, batch)
+        for leaf in jax.tree_util.tree_leaves(result):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        return result
 
-    def _flush(self):
-        """Group every pending frame by (shape, dtype) -- stacking
-        float16 with float32 frames would silently promote, running
-        the narrower frame at a different precision than the blocking
-        path -- and hand the batches to the fetch worker.  Dispatch
-        (including a first-use jit compile, ~40 s through a congested
-        link) happens THERE, so the event loop never blocks on detect
-        device work and other stages' frames keep flowing."""
-        pending, self._pending = self._pending, []
-        if not pending or self._fetch_queue is None:
-            for complete, image in pending:     # stopped mid-burst
-                complete(StreamEvent.ERROR,
-                         {"diagnostic": "detector stopped"})
-            return
-        by_shape: dict[tuple, list] = {}
-        for complete, image in pending:
-            try:
-                array = self._preprocess(image)
-            except Exception as error:      # malformed frame: only ITS
-                complete(StreamEvent.ERROR,  # complete errors
-                         {"diagnostic": f"bad image: {error}"})
-                continue
-            by_shape.setdefault(
-                (tuple(array.shape), str(array.dtype)), []).append(
-                (complete, image, array))
-        if by_shape:
-            # The model is SNAPSHOTTED with the batch: on_replacement
-            # (mesh failure) nulls self._detect/_params on the event
-            # loop while batches may still be queued -- a queued batch
-            # must dispatch against the weights it was built with (or
-            # fail cleanly if those weights' devices died), never
-            # against a half-swapped model or a None.
-            self._fetch_queue.put(
-                (self._detect, self._params, list(by_shape.values())))
-
-    def _run_batches(self, detect, params, groups):
-        """Fetch-worker side of a flush: dispatch EVERY group first
-        (device work pipelines across groups), then fetch and complete
-        each.  A failing dispatch errors every frame of ITS group --
-        anything not completed here would stay parked forever."""
-        dispatched = []
-        for group in groups:
-            try:
-                arrays = [array for _, _, array in group]
-                # Pad rows repeat the first image: idempotent compute,
-                # no uninitialized values, at most doubles a ragged
-                # batch.
-                bucket = next_power_of_two(len(arrays))
-                arrays += [arrays[0]] * (bucket - len(arrays))
-                result = detect(params, jnp.stack(arrays))
-                for leaf in jax.tree_util.tree_leaves(result):
-                    if hasattr(leaf, "copy_to_host_async"):
-                        leaf.copy_to_host_async()
-            except Exception as error:
-                self.logger.exception("batched detect dispatch failed")
-                for complete, _, _ in group:
-                    complete(StreamEvent.ERROR,
-                             {"diagnostic": f"detect dispatch: {error}"})
-                continue
-            dispatched.append((group, result))
-        for group, result in dispatched:
-            self._finish_batch(
-                [(complete, image) for complete, image, _ in group],
-                result)
-
-    def _fetch_loop(self, fetch_queue):
-        while True:
-            item = fetch_queue.get()
-            if item is None:          # drain-then-exit sentinel
-                return
-            self._run_batches(*item)
-
-    def _stop_fetcher(self):
-        """Retire the fetch thread (in-flight frames drain first); a
-        later async frame lazily starts a fresh one.  Without this the
-        thread would pin the element -- and its device weights --
-        forever."""
-        fetch_queue, self._fetch_queue = self._fetch_queue, None
-        if fetch_queue is not None:
-            fetch_queue.put(None)
-
-    def stop_stream(self, stream, stream_id):
-        self._flush()                   # in-flight micro-batch first
-        self._stop_fetcher()
-        return super().stop_stream(stream, stream_id)
-
-    def _finish_batch(self, frames, result):
-        """Fetch one batched result (a single blocking host copy for the
-        whole micro-batch) and complete each frame from its row."""
+    def batch_finish(self, context, key, entries, result):
+        """Fetch the batched result dict in ONE ``jax.device_get`` (the
+        boxes/scores/classes/valid rows land host-side together -- a
+        single blocking copy for the whole micro-batch, not four syncs
+        per frame) and complete each frame from its row."""
         try:
-            fetched = {key: np.asarray(value)
-                       for key, value in result.items()}
+            fetched = jax.device_get(dict(result))
         except Exception as error:            # pragma: no cover - defensive
-            for complete, _ in frames:
+            for complete, _ in entries:
                 complete(StreamEvent.ERROR, {"diagnostic": str(error)})
             return
-        for row, (complete, image) in enumerate(frames):
+        for row, (complete, image) in enumerate(entries):
             try:
                 outputs = self._postprocess(image, fetched, row)
             except Exception as error:        # pragma: no cover - defensive
@@ -253,16 +185,23 @@ class Detector(TPUElement):
                 continue
             complete(StreamEvent.OKAY, outputs)
 
+    # -- blocking path ------------------------------------------------------
+
     def process_frame(self, stream, image=None, **inputs):
         self._ensure_model()
-        result = self._dispatch(image)
+        # ONE explicit host fetch of the whole result dict; the row
+        # loop below then runs on host arrays with zero device syncs.
+        result = jax.device_get(dict(self._dispatch(image)))
         return StreamEvent.OKAY, self._postprocess(image, result)
 
-    def _postprocess(self, image, result, row: int = 0) -> dict:
-        boxes = np.asarray(result["boxes"][row], dtype=np.float32)
-        scores = np.asarray(result["scores"][row], dtype=np.float32)
-        classes = np.asarray(result["classes"][row])
-        valid = np.asarray(result["valid"][row])
+    def _postprocess(self, image, fetched: dict, row: int = 0) -> dict:
+        """Build overlay/detections from the HOST-fetched result dict
+        (callers did the one ``jax.device_get``; nothing here touches
+        the device)."""
+        boxes = np.asarray(fetched["boxes"][row], dtype=np.float32)
+        scores = np.asarray(fetched["scores"][row], dtype=np.float32)
+        classes = np.asarray(fetched["classes"][row])
+        valid = np.asarray(fetched["valid"][row])
 
         rectangles, detections = [], []
         for i in np.nonzero(valid)[0]:

@@ -20,6 +20,7 @@ except ImportError:                                 # pragma: no cover
 import jax
 import jax.numpy as jnp
 
+from ..models.batching import MicroBatchElement, pad_to_bucket
 from ..pipeline import DataSource, DataTarget, PipelineElement, StreamEvent
 from .scheme_file import DataSchemeFile
 
@@ -80,6 +81,8 @@ class ImageWriteFile(DataTarget):
     """Writes ``image`` to ``data_targets`` path; ``{}`` templates get the
     frame index (reference image_io.py:372-407)."""
 
+    host_inputs = ("image",)    # sink: the engine fetches explicitly
+
     def process_frame(self, stream, image=None, **inputs):
         scheme = self.scheme_for(stream)
         if not isinstance(scheme, DataSchemeFile):
@@ -93,18 +96,40 @@ class ImageWriteFile(DataTarget):
         return StreamEvent.OKAY, {"path": path}
 
 
-class ImageResize(PipelineElement):
+class ImageResize(MicroBatchElement, PipelineElement):
     """Resize ``image`` to ``width`` x ``height`` parameters -- jax
     bilinear resize, on-device (reference image_io.py:343-371 does PIL
-    resize on host)."""
+    resize on host).
+
+    ASYNC by default: frames parked here -- from every stream -- resize
+    together as one batched [N, H, W, C] device call (MicroBatcher;
+    same admission as the Detector), and each frame's output row stays
+    DEVICE-RESIDENT for the next device stage.  A host-side burst
+    stacks once and uploads once.  Set ``synchronous: true`` for the
+    blocking path.
+    """
+
+    is_async = True
+    device_resident = True
 
     def __init__(self, context):
         super().__init__(context)
+        # Static target size; identity scale on the leading batch dim,
+        # so the same computation serves [H, W, ...] and [N, H, W, ...].
         self._resize = jax.jit(
             lambda x, h, w: jax.image.resize(
                 x.astype(jnp.float32),
-                (h, w) + x.shape[2:], method="bilinear"),
+                x.shape[:-3] + (h, w) + x.shape[-1:]
+                if x.ndim >= 3 else (h, w), method="bilinear"),
             static_argnums=(1, 2))
+
+    def _resize_one(self, image, height: int, width: int):
+        image = jnp.asarray(image)
+        resized = self._resize(image, height, width)
+        if image.dtype == jnp.uint8:
+            resized = jnp.clip(jnp.round(resized), 0, 255) \
+                .astype(jnp.uint8)
+        return resized
 
     def process_frame(self, stream, image=None, **inputs):
         width, _ = self.get_parameter("width")
@@ -112,12 +137,46 @@ class ImageResize(PipelineElement):
         if not width or not height:
             return StreamEvent.ERROR, {
                 "diagnostic": "ImageResize needs width/height parameters"}
-        image = jnp.asarray(image)
-        resized = self._resize(image, int(height), int(width))
-        if image.dtype == jnp.uint8:
+        return StreamEvent.OKAY, {
+            "image": self._resize_one(image, int(height), int(width))}
+
+    def process_frame_start(self, stream, complete, image=None, **inputs):
+        self.submit_microbatch(complete, image, diagnostic="bad image")
+
+    def batch_key(self, image):
+        # Target size rides the key: streams resizing to different
+        # sizes (or from different source shapes) never stack.
+        width, _ = self.get_parameter("width")
+        height, _ = self.get_parameter("height")
+        if not width or not height:
+            raise ValueError("ImageResize needs width/height parameters")
+        if not hasattr(image, "shape"):     # array-likes: numpy metadata
+            image = np.asarray(image)
+        return (int(height), int(width), tuple(image.shape),
+                str(image.dtype))
+
+    def batch_run(self, context, key, images):
+        height, width, shape, _ = key
+        images = pad_to_bucket(images)
+        if all(isinstance(image, np.ndarray) for image in images):
+            batch = jnp.asarray(np.stack(images))   # one upload
+        else:
+            batch = jnp.stack([jnp.asarray(image) for image in images])
+        if len(shape) == 2:             # grayscale: batch as [N, H, W, 1]
+            batch = batch[..., None]
+        resized = self._resize(batch, height, width)
+        if batch.dtype == jnp.uint8:
             resized = jnp.clip(jnp.round(resized), 0, 255) \
                 .astype(jnp.uint8)
-        return StreamEvent.OKAY, {"image": resized}
+        return resized
+
+    def batch_finish(self, context, key, entries, result):
+        if len(key[2]) == 2:
+            result = result[..., 0]     # undo the grayscale channel dim
+        for row, (complete, _) in enumerate(entries):
+            # Row slices stay device-resident: the next device stage
+            # consumes them without any host round trip.
+            complete(StreamEvent.OKAY, {"image": result[row]})
 
 
 class ImageOverlay(PipelineElement):
@@ -129,6 +188,10 @@ class ImageOverlay(PipelineElement):
     boxes here are drawn host-side too -- rectangles are tiny -- but the
     image returns as a jax array so the pipeline stays tensor-native).
     """
+
+    # PIL drawing is host work: declare it, so the engine fetches the
+    # image with ONE counted device_get instead of an implicit sync.
+    host_inputs = ("image",)
 
     def process_frame(self, stream, image=None, overlay=None, **inputs):
         if overlay is None:

@@ -9,5 +9,6 @@ from .pipeline import Pipeline, RemoteStage, PROTOCOL_PIPELINE, \
 from .scheme import DataScheme, DataSource, DataTarget, contains_all
 from .codec import (encode_frame_data, decode_frame_data, encode_value,
                     decode_value)
+from .overlap import TransferLedger, DeviceWindow, device_leaves
 from .tensor import (TPUElement, JitCache, ShapeBucketer, StagePlacement,
                      encode_array, decode_array, tree_device_put)

@@ -1,13 +1,22 @@
 """Frame wire codec: swag values <-> S-expression-safe strings.
 
 Local (in-process) frames never touch this -- swag values including
-``jax.Array``s pass by reference.  Only frames crossing a process boundary
-on the *control* fabric are encoded: scalars/lists/dicts as S-expression
-terms, numpy/jax arrays as base64 .npy blobs (the equivalent of the
-reference's PE_DataEncode/Decode elements, reference
-examples/pipeline/elements.py:214-246).  Bulk tensor traffic should use
-the tensor transport (tpu/transfer) instead; this codec is the correctness
-fallback, not the fast path.
+``jax.Array``s pass by reference, and with the device-resident swag
+contract (pipeline/overlap.py) they stay in HBM between elements.  Only
+frames crossing a process boundary on the *control* fabric are encoded,
+and the boundary is EXPLICIT: the engine fetches every device leaf with
+one counted ``TransferLedger.fetch`` (a single ``jax.device_get``)
+before calling :func:`encode_frame_data`, so this codec only ever sees
+host values -- an encode is never the hidden device sync it was when
+``np.asarray`` here was the fetch.  Scalars/lists/dicts encode as
+S-expression terms, host arrays as base64 .npy blobs (the equivalent of
+the reference's PE_DataEncode/Decode elements, reference
+examples/pipeline/elements.py:214-246).  Extension dtypes (bfloat16 and
+friends -- ml_dtypes, which .npy cannot represent: they round-trip as
+raw ``V2`` bytes and lose the dtype) ride a tagged integer view
+instead.  Bulk tensor traffic should use the tensor transport
+(tpu/transfer); this codec is the correctness fallback, not the fast
+path.
 """
 
 from __future__ import annotations
@@ -21,15 +30,41 @@ __all__ = ["encode_value", "decode_value", "encode_frame_data",
            "decode_frame_data"]
 
 _NPY_PREFIX = "npy64:"
+# Extension-dtype arrays (ml_dtypes: bfloat16, float8_*...):
+# ``npyt:<dtype_name>:<base64 npy of the same-itemsize integer view>``.
+# The integer view preserves shape (0-d included) and byte layout; the
+# tag restores the dtype on decode.
+_NPYT_PREFIX = "npyt:"
+_VIEW_BY_ITEMSIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32}
+
+
+def _extension_dtype(dtype: np.dtype) -> bool:
+    """True only for ml_dtypes extension dtypes the tagged view can
+    restore; plain/structured void dtypes fall back to the npy path."""
+    if dtype.kind != "V" or dtype.names is not None \
+            or dtype.itemsize not in _VIEW_BY_ITEMSIZE:
+        return False
+    import ml_dtypes
+    return hasattr(ml_dtypes, dtype.name)
+
+
+def _save_npy(array: np.ndarray) -> str:
+    buffer = io.BytesIO()
+    np.save(buffer, array, allow_pickle=False)
+    return base64.b64encode(buffer.getvalue()).decode()
 
 
 def encode_value(value):
     if hasattr(value, "__array__") and not isinstance(
             value, (str, bytes, list, tuple, dict)):
         array = np.asarray(value)
-        buffer = io.BytesIO()
-        np.save(buffer, array, allow_pickle=False)
-        return _NPY_PREFIX + base64.b64encode(buffer.getvalue()).decode()
+        if _extension_dtype(array.dtype):
+            # ml_dtypes extension dtype: npy would strip it to raw
+            # bytes.  Encode the integer view + a dtype tag.
+            view = _VIEW_BY_ITEMSIZE[array.dtype.itemsize]
+            return (f"{_NPYT_PREFIX}{array.dtype.name}:"
+                    f"{_save_npy(array.view(view))}")
+        return _NPY_PREFIX + _save_npy(array)
     if isinstance(value, (list, tuple)):
         return [encode_value(v) for v in value]
     if isinstance(value, dict):
@@ -37,10 +72,22 @@ def encode_value(value):
     return value
 
 
+def _load_npy(data: str) -> np.ndarray:
+    raw = base64.b64decode(data)
+    return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
 def decode_value(value):
     if isinstance(value, str) and value.startswith(_NPY_PREFIX):
-        raw = base64.b64decode(value[len(_NPY_PREFIX):])
-        return np.load(io.BytesIO(raw), allow_pickle=False)
+        return _load_npy(value[len(_NPY_PREFIX):])
+    if isinstance(value, str) and value.startswith(_NPYT_PREFIX):
+        dtype_name, _, payload = value[len(_NPYT_PREFIX):].partition(":")
+        import ml_dtypes
+        if not hasattr(ml_dtypes, dtype_name):
+            raise ValueError(
+                f"codec: unknown extension dtype {dtype_name!r}")
+        dtype = np.dtype(getattr(ml_dtypes, dtype_name))
+        return _load_npy(payload).view(dtype)
     if isinstance(value, list):
         return [decode_value(v) for v in value]
     if isinstance(value, dict):

@@ -50,6 +50,23 @@ class PipelineElement:
     #: flight across stages (detect(k+1) overlaps decode(k)).
     is_async = False
 
+    #: Device-resident swag contract (pipeline/overlap.py): elements
+    #: hosting device computation set this True.  Their outputs may (and
+    #: should) stay ``jax.Array`` -- un-synced, still computing -- and
+    #: the engine runs their event-loop execution under the pipeline's
+    #: transfer guard, so an implicit device->host sync inside one is
+    #: recorded (policy ``log``) or fails the frame fast (policy
+    #: ``disallow``) instead of silently halving throughput.
+    device_resident = False
+
+    #: Input names this element always needs materialized on host.  The
+    #: engine fetches them all together (ONE counted ``jax.device_get``
+    #: per element per frame) before ``process_frame`` -- the
+    #: class-level complement of a definition input's
+    #: ``"type": "host"``.  Everything else arrives as-is: device
+    #: values stay device-resident between device stages.
+    host_inputs: tuple = ()
+
     def __init__(self, context: ElementContext):
         self.context = context
         self.name = context.name

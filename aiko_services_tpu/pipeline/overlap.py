@@ -1,0 +1,191 @@
+"""Overlapped frame execution: device-resident swag accounting and the
+bounded per-stream dispatch window (ISSUE 1 tentpole; Vortex
+arXiv:2511.02062 and the profiled-segmentation multi-TPU work
+arXiv:2503.01025 both identify host/device overlap + device residency as
+what turns component-fast pipelines into end-to-end-fast ones).
+
+Two small engine-side mechanisms:
+
+- :class:`TransferLedger` enforces and accounts the **device-resident
+  swag contract**: between consecutive device elements swag values stay
+  ``jax.Array`` in HBM; the host only sees them at a sink (wire
+  response, process boundary) or at an input explicitly declared
+  host-typed.  Device elements run under
+  ``jax.transfer_guard_device_to_host`` with the configured policy
+  (pipeline parameter ``transfer_guard``: ``allow`` | ``log`` |
+  ``disallow``), every engine-initiated fetch is ONE counted
+  ``jax.device_get`` of the whole tree, and a software residency check
+  catches declared-``tensor`` outputs that come back as host arrays --
+  the CPU backend's device-to-host "transfers" are zero-copy so the
+  jax guard never fires there, but the residency check does, which is
+  what lets tier-1 tests fail fast on host-sync regressions without
+  TPU hardware.
+
+- :class:`DeviceWindow` bounds how far dispatch runs ahead of compute:
+  jitted elements return un-synced arrays and frames complete without a
+  host sync, so a fast source could otherwise enqueue unbounded device
+  work (and pin unbounded HBM in not-yet-computed results).  Each
+  completed frame's device leaves are noted; ingesting a new frame
+  paces the window by ``block_until_ready``-ing the OLDEST noted frame
+  until at most ``device_inflight`` frames (default triple buffering)
+  are outstanding -- classic double/triple buffering per stream.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import deque
+
+import jax
+import numpy as np
+
+__all__ = ["TransferLedger", "DeviceWindow", "device_leaves",
+           "DEVICE_INFLIGHT_DEFAULT"]
+
+TRANSFER_POLICIES = ("allow", "log", "disallow")
+
+# Default bounded async-dispatch window per stream (triple buffering);
+# override with the ``device_inflight`` pipeline/stream parameter
+# (0 disables pacing).
+DEVICE_INFLIGHT_DEFAULT = 3
+
+
+def device_leaves(tree) -> list:
+    """Every ``jax.Array`` leaf of a swag/pytree (host values skipped)."""
+    return [leaf for leaf in jax.tree_util.tree_leaves(tree)
+            if isinstance(leaf, jax.Array)]
+
+
+class TransferLedger:
+    """Counts (and can forbid) host transfers on the frame path.
+
+    ``implicit`` counts contract violations: transfers the engine did
+    not initiate -- a jax transfer-guard error raised inside a device
+    element (policy ``disallow`` on real hardware), or a
+    declared-``tensor`` output arriving as a host ``np.ndarray`` (any
+    policy except ``allow``, any backend).  Under ``log`` the jax-level
+    guard only writes to jax's own log (nothing raises, so nothing can
+    be counted from it); the residency check is what increments the
+    counter there.  ``explicit`` counts engine-initiated fetches
+    (host-typed inputs, process-boundary encodes), each ONE
+    ``jax.device_get`` of the whole tree regardless of leaf count.
+    Healthy pipelines keep ``implicit`` at 0; the bench reports it as
+    ``swag_host_transfers``.
+    """
+
+    def __init__(self, policy: str = "allow"):
+        policy = str(policy or "allow").strip().lower()
+        if policy not in TRANSFER_POLICIES:
+            raise ValueError(f"transfer_guard={policy!r}: one of "
+                             f"{TRANSFER_POLICIES}")
+        self.policy = policy
+        self.implicit = 0
+        self.explicit = 0
+
+    @property
+    def active(self) -> bool:
+        return self.policy != "allow"
+
+    @contextlib.contextmanager
+    def guard(self):
+        """Wrap one device element's event-loop execution.  Thread-local
+        (jax config context), so an element's own fetch worker threads
+        are unaffected -- fetching at the element's sink is its job."""
+        if not self.active:
+            yield
+            return
+        with jax.transfer_guard_device_to_host(self.policy):
+            yield
+
+    def record_implicit(self, count: int = 1):
+        self.implicit += count
+
+    @staticmethod
+    def is_guard_error(error: BaseException) -> bool:
+        message = str(error).lower()
+        return "transfer" in message and "disallow" in message
+
+    def fetch(self, tree):
+        """ONE explicit host fetch of every device leaf in ``tree``
+        (non-array leaves pass through untouched -- strings/lists/dicts
+        in a swag must not become numpy).  Counted once per call, not
+        per leaf; runs under an ``allow`` scope so the engine's own
+        sinks never trip the guard they enforce."""
+        leaves = device_leaves(tree)
+        if not leaves:
+            return tree
+        self.explicit += 1
+        with jax.transfer_guard_device_to_host("allow"):
+            for leaf in leaves:
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()     # gather copies in flight
+            fetched = iter(jax.device_get(leaves))
+        return jax.tree_util.tree_map(
+            lambda leaf: next(fetched)
+            if isinstance(leaf, jax.Array) else leaf, tree)
+
+    def residency_violations(self, element, outputs: dict) -> list[str]:
+        """Declared device outputs (definition ``"type": "tensor"`` /
+        ``"device"``) that came back host-resident: the software twin of
+        the jax guard, effective on every backend."""
+        declared = element.definition.output if element.definition else []
+        violations = []
+        for io in declared:
+            io_type = str(io.get("type", "")).rstrip("?")
+            if io_type not in ("tensor", "device"):
+                continue
+            value = outputs.get(io["name"])
+            if value is not None and isinstance(value, np.ndarray):
+                violations.append(io["name"])
+        return violations
+
+    @property
+    def stats(self) -> dict:
+        return {"policy": self.policy, "implicit": self.implicit,
+                "explicit": self.explicit}
+
+
+class DeviceWindow:
+    """Per-stream bounded in-flight accounting of dispatched-but-unsynced
+    frames (double/triple buffering).  Owned by the event loop; no
+    locking."""
+
+    def __init__(self):
+        self._inflight: deque = deque()      # (frame_id, device leaves)
+        self.noted = 0                       # frames entering the window
+        self.synced = 0                      # frames paced to completion
+
+    def note(self, frame_id: int, swag) -> None:
+        """Register a completed frame's outstanding device work."""
+        leaves = device_leaves(swag)
+        if leaves:
+            self._inflight.append((frame_id, leaves))
+            self.noted += 1
+
+    def pace(self, limit) -> None:
+        """Block (oldest-first) until at most ``limit - 1`` frames stay
+        outstanding, so the frame about to dispatch makes ``limit``.
+        ``limit`` <= 0 or None disables pacing (unbounded dispatch)."""
+        if not limit or limit <= 0:
+            return
+        while len(self._inflight) >= limit:
+            _, leaves = self._inflight.popleft()
+            jax.block_until_ready(leaves)
+            self.synced += 1
+
+    def drain(self) -> None:
+        """Sync everything outstanding (stream flush, tests)."""
+        self.pace(1)
+
+    def clear(self) -> None:
+        """Drop bookkeeping without blocking (stream destroy)."""
+        self._inflight.clear()
+
+    @property
+    def outstanding(self) -> int:
+        return len(self._inflight)
+
+    @property
+    def stats(self) -> dict:
+        return {"outstanding": self.outstanding, "noted": self.noted,
+                "synced": self.synced}

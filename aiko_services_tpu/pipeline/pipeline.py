@@ -31,6 +31,7 @@ from .codec import decode_frame_data, encode_frame_data
 from .definition import (PipelineDefinition, parse_pipeline_definition,
                          load_pipeline_definition, DefinitionError)
 from .element import ElementContext, PipelineElement, PipelineElementLoop
+from .overlap import DEVICE_INFLIGHT_DEFAULT, TransferLedger
 from .stream import (Stream, Frame, StreamEvent, StreamState,
                      DEFAULT_STREAM_ID)
 from ..runtime import Lease
@@ -97,6 +98,11 @@ class Pipeline(Actor):
         self.streams: dict[str, Stream] = {}
         self._current_stream_ref: Stream | None = None
         self._pipeline_parameters = dict(definition.parameters)
+        # Device-resident swag accounting (pipeline/overlap.py): the
+        # ``transfer_guard`` parameter sets the policy for every
+        # device-resident element's event-loop execution.
+        self.transfer_ledger = TransferLedger(
+            definition.parameters.get("transfer_guard", "allow"))
         self.stage_placement = self._build_placement()
         self.graph = self._build_graph()
         self.share["element_count"] = len(self.graph)
@@ -272,6 +278,15 @@ class Pipeline(Actor):
     def current_stream(self) -> Stream | None:
         return self._current_stream_ref
 
+    def transfer_stats(self) -> dict:
+        """Device-resident swag accounting: the TransferLedger counters
+        plus the live streams' dispatch-window stats (bench.py reports
+        ``implicit`` as ``swag_host_transfers``)."""
+        stats = dict(self.transfer_ledger.stats)
+        stats["window"] = {stream_id: stream.device_window.stats
+                           for stream_id, stream in self.streams.items()}
+        return stats
+
     # -- stream lifecycle --------------------------------------------------
 
     def create_stream(self, stream_id=None, *parameters):
@@ -309,6 +324,11 @@ class Pipeline(Actor):
                         parameters=dict(parameters or {}),
                         queue_response=queue_response,
                         topic_response=topic_response)
+        stream.device_inflight = int(parse_number(
+            stream.parameters.get(
+                "device_inflight",
+                self._pipeline_parameters.get("device_inflight")),
+            DEVICE_INFLIGHT_DEFAULT))
         if grace_time:
             stream.lease = Lease(
                 self.runtime.engine, float(grace_time), stream_id,
@@ -397,6 +417,8 @@ class Pipeline(Actor):
             stream.state = StreamState.STOP
         if stream.lease is not None:
             stream.lease.terminate()
+        stream.device_window.clear()    # drop refs without blocking
+        self.share["swag_host_transfers"] = self.transfer_ledger.implicit
         self._current_stream_ref = stream
         try:
             for node in self._stream_path(stream):
@@ -442,6 +464,10 @@ class Pipeline(Actor):
         frame = Frame(frame_id=stream.next_frame_id(),
                       swag=dict(frame_data))
         stream.frames[frame.frame_id] = frame
+        # Bounded dispatch window: before this frame's device work
+        # enqueues, sync the oldest completed-but-unsynced frame(s) so
+        # dispatch stays at most device_inflight frames ahead.
+        stream.device_window.pace(stream.device_inflight)
         self._process_frame_common(stream, frame)
 
     def _ingest(self, stream_dict: dict, frame_data: dict):
@@ -457,6 +483,7 @@ class Pipeline(Actor):
         frame = Frame(frame_id=int(frame_id), swag=dict(frame_data))
         frame.response_topic = stream_dict.get("response_topic")
         stream.frames[frame.frame_id] = frame
+        stream.device_window.pace(stream.device_inflight)
         self._process_frame_common(stream, frame)
 
     # -- the hot loop ------------------------------------------------------
@@ -492,7 +519,7 @@ class Pipeline(Actor):
                                    [stream.stream_id, frame, node.name],
                                    delay=0.25)
                     return
-                inputs, missing = self._map_in(node, swag)
+                inputs, missing, host_typed = self._map_in(node, swag)
                 if missing:
                     self._frame_error(
                         stream, frame,
@@ -502,22 +529,44 @@ class Pipeline(Actor):
                         and node.name in self.stage_placement.plans:
                     # Stage hop: reshard this stage's inputs onto its
                     # submesh (device-to-device over ICI; a no-op when
-                    # already resident there).
-                    inputs = self.stage_placement.transfer(inputs,
-                                                           node.name)
+                    # already resident there).  Host-typed inputs stay
+                    # host-side -- re-uploading what _map_in just
+                    # fetched would undo the contract.
+                    inputs.update(self.stage_placement.transfer(
+                        {name: value for name, value in inputs.items()
+                         if name not in host_typed}, node.name))
                 self.run_hook("pipeline.process_element:0",
                               lambda: {"element": node.name,
+                                       "stream": stream.stream_id,
                                        "frame": frame.frame_id})
                 if element.frame_is_async(stream):
                     self._submit_frame_async(stream, frame, node, inputs)
                     return        # frame parked at local async stage
                 start = time.perf_counter()
+                # Absolute start stamp: with overlapped frames, element
+                # spans interleave across frames -- durations alone
+                # cannot show (or test) that k+1's first element began
+                # before k's last completed.
+                frame.metrics[f"{node.name}_time_start"] = start
                 if _METRICS_MEMORY:
                     rss_before = process_memory_rss()
+                ledger = self.transfer_ledger
                 try:
-                    result = element.process_frame(stream, **inputs)
+                    if element.device_resident and ledger.active:
+                        # Device elements run under the transfer guard:
+                        # an implicit device->host sync inside one is a
+                        # contract violation, not business as usual.
+                        with ledger.guard():
+                            result = element.process_frame(stream,
+                                                           **inputs)
+                    else:
+                        result = element.process_frame(stream, **inputs)
                 except Exception as error:
+                    if ledger.is_guard_error(error):
+                        ledger.record_implicit()
                     self.logger.exception("element %s raised", node.name)
+                    self._element_post_error(stream, frame, node.name,
+                                             start)
                     self._frame_error(stream, frame,
                                       f"{node.name}: {error}")
                     return
@@ -529,8 +578,15 @@ class Pipeline(Actor):
                 event, outputs = result if isinstance(result, tuple) \
                     else (result, {})
                 outputs = outputs or {}
+                if ledger.active and outputs and not \
+                        self._check_residency(stream, frame, node,
+                                              element, outputs):
+                    self._element_post_error(stream, frame, node.name,
+                                             start)
+                    return
                 self.run_hook("pipeline.process_element_post:0",
                               lambda: {"element": node.name,
+                                       "stream": stream.stream_id,
                                        "frame": frame.frame_id,
                                        "event": event,
                                        "time":
@@ -587,6 +643,7 @@ class Pipeline(Actor):
         stream_id, frame_id = stream.stream_id, frame.frame_id
         node_name = node.name
         start = time.perf_counter()
+        frame.metrics[f"{node_name}_time_start"] = start
         state = {"done": False}
         state_lock = threading.Lock()   # complete() may race itself
                                         # across threads; the resume
@@ -602,13 +659,25 @@ class Pipeline(Actor):
                             outputs or {},
                             time.perf_counter() - start])
 
+        ledger = self.transfer_ledger
         try:
-            node.element.process_frame_start(stream, complete, **inputs)
+            if node.element.device_resident and ledger.active:
+                # The submit path is device-element event-loop work
+                # too: an implicit host sync here blocks every stream.
+                with ledger.guard():
+                    node.element.process_frame_start(stream, complete,
+                                                     **inputs)
+            else:
+                node.element.process_frame_start(stream, complete,
+                                                 **inputs)
         except Exception as error:
+            if ledger.is_guard_error(error):
+                ledger.record_implicit()
             self.logger.exception("element %s submit raised", node_name)
             with state_lock:
                 state["done"] = True    # a late complete() must not win
             frame.paused_pe_name = None
+            self._element_post_error(stream, frame, node_name, start)
             self._frame_error(stream, frame, f"{node_name}: {error}")
 
     def resume_frame_local(self, stream_id, frame_id, node_name,
@@ -625,10 +694,15 @@ class Pipeline(Actor):
         frame.metrics[f"{node_name}_time"] = elapsed
         self.run_hook("pipeline.process_element_post:0",
                       lambda: {"element": node_name,
+                               "stream": stream.stream_id,
                                "frame": frame.frame_id,
                                "event": event, "time": elapsed})
         outputs = outputs if isinstance(outputs, dict) else {}
         node = self.graph.get_node(node_name)
+        if self.transfer_ledger.active and outputs and not \
+                self._check_residency(stream, frame, node, node.element,
+                                      outputs):
+            return
         if event in (StreamEvent.OKAY, StreamEvent.LOOP_END):
             self._map_out(node, frame.swag, outputs)
             nodes = self.graph.iterate_after(node_name, stream.graph_path)
@@ -672,21 +746,70 @@ class Pipeline(Actor):
 
     # -- name mapping ------------------------------------------------------
 
-    @staticmethod
-    def _map_in(node, swag: dict) -> tuple[dict, list]:
+    def _map_in(self, node, swag: dict) -> tuple[dict, list, list]:
+        """Returns (inputs, missing, host_typed): the host-typed names
+        were materialized host-side and must stay there -- a placement
+        transfer re-uploading them would undo the contract."""
         element = node.element
-        inputs, missing = {}, []
+        inputs, missing, host_typed = {}, [], []
         mapping = node.properties or {}
+        host_inputs = element.host_inputs
         for io in (element.definition.input if element.definition else []):
             name = io["name"]
             key = mapping.get(name, name)
             if key in swag:
                 inputs[name] = swag[key]
+                if name in host_inputs or \
+                        str(io.get("type", "")).rstrip("?") == "host":
+                    host_typed.append(name)
             elif io.get("type", "").endswith("?") or "default" in io:
                 inputs[name] = io.get("default")
             else:
                 missing.append(name)
-        return inputs, missing
+        if host_typed:
+            # Explicitly host-typed inputs: THE sanctioned spot where
+            # device-resident swag values reach the host mid-graph --
+            # ONE counted fetch for all of them together, not an
+            # implicit sync inside the element.
+            inputs.update(self.transfer_ledger.fetch(
+                {name: inputs[name] for name in host_typed}))
+        return inputs, missing, host_typed
+
+    def _element_post_error(self, stream: Stream, frame: Frame,
+                            node_name: str, start: float):
+        """Pair the enter hook on element-failure paths, so hook
+        consumers (the profiler's open spans, recorders) never see an
+        unmatched enter -- a dangling TraceAnnotation would nest the
+        whole remaining trace under the dead element."""
+        self.run_hook("pipeline.process_element_post:0",
+                      lambda: {"element": node_name,
+                               "stream": stream.stream_id,
+                               "frame": frame.frame_id,
+                               "event": StreamEvent.ERROR,
+                               "time": time.perf_counter() - start})
+
+    def _check_residency(self, stream: Stream, frame: Frame, node,
+                         element, outputs: dict) -> bool:
+        """Software half of the transfer guard (effective on backends
+        where device->host is zero-copy and the jax guard cannot fire):
+        declared-``tensor`` outputs must still be device-resident.
+        Returns False when the frame was errored (policy disallow)."""
+        if not element.device_resident:
+            return True
+        violations = self.transfer_ledger.residency_violations(element,
+                                                               outputs)
+        if not violations:
+            return True
+        self.transfer_ledger.record_implicit(len(violations))
+        if self.transfer_ledger.policy == "disallow":
+            self._frame_error(
+                stream, frame,
+                f"{node.name}: device outputs fetched to host: "
+                f"{violations} (transfer_guard=disallow)")
+            return False
+        self.logger.warning("%s: device outputs fetched to host: %s",
+                            node.name, violations)
+        return True
 
     @staticmethod
     def _map_out(node, swag: dict, outputs: dict):
@@ -701,6 +824,10 @@ class Pipeline(Actor):
             time.perf_counter() - frame.metrics["time_pipeline_start"])
         stream.last_frame_time = time.monotonic()   # grace lease clock
         stream.frames.pop(frame.frame_id, None)
+        # The frame COMPLETES without a host sync: its device leaves may
+        # still be computing (async dispatch).  Note them so ingest
+        # pacing bounds how far dispatch runs ahead of compute.
+        stream.device_window.note(frame.frame_id, frame.swag)
         self._frames_processed += 1
         self.share["frames_processed"] = self._frames_processed
         if not frame.metrics.get("dropped"):
@@ -721,6 +848,10 @@ class Pipeline(Actor):
         if frame.response_topic:
             bare_swag = {k: v for k, v in frame.swag.items()
                          if "." not in k}
+            # Process boundary: THE sink where device-resident swag
+            # values are fetched -- one explicit counted device_get for
+            # the whole response, then the host-side codec.
+            bare_swag = self.transfer_ledger.fetch(bare_swag)
             payload = generate("process_frame_response", [
                 {"stream_id": stream.stream_id,
                  "frame_id": frame.frame_id,
@@ -739,13 +870,16 @@ class Pipeline(Actor):
         if stage.remote_topic_path is None:
             return False
         frame.paused_pe_name = node.name
-        inputs, _ = self._map_in(node, frame.swag)
+        inputs, _, _ = self._map_in(node, frame.swag)
         # Forward ALL mapped inputs; the remote pipeline maps what it needs.
+        # Process boundary: explicit single fetch before the host codec.
+        forwarded = self.transfer_ledger.fetch(
+            inputs if inputs else {
+                k: v for k, v in frame.swag.items() if "." not in k})
         payload = generate("process_frame", [
             {"stream_id": stream.stream_id, "frame_id": frame.frame_id,
              "response_topic": self.topic_in},
-            encode_frame_data(inputs if inputs else {
-                k: v for k, v in frame.swag.items() if "." not in k})])
+            encode_frame_data(forwarded)])
         self.runtime.message.publish(f"{stage.remote_topic_path}/in",
                                      payload)
         return True

@@ -19,6 +19,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
+from .overlap import DEVICE_INFLIGHT_DEFAULT, DeviceWindow
+
 __all__ = ["StreamEvent", "StreamState", "Frame", "Stream",
            "DEFAULT_STREAM_ID", "FIRST_FRAME_ID"]
 
@@ -68,6 +70,12 @@ class Stream:
     lease: Any = None
     generator_handles: list = field(default_factory=list)
     last_frame_time: float = field(default_factory=time.monotonic)
+    # Bounded async-dispatch window: completed frames whose device work
+    # may still be computing (jitted elements return un-synced arrays).
+    # Paced at ingest so dispatch stays at most ``device_inflight``
+    # frames ahead of compute (pipeline/overlap.py).
+    device_window: DeviceWindow = field(default_factory=DeviceWindow)
+    device_inflight: int = DEVICE_INFLIGHT_DEFAULT
 
     def next_frame_id(self) -> int:
         frame_id = self.frame_count

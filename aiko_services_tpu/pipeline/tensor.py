@@ -251,7 +251,14 @@ class TPUElement(PipelineElement):
     ``{"dp": 2, "tp": 4}``, or a stage name previously assigned on the
     pipeline's StagePlacement.  Subclasses use ``self.jit`` for
     shape-keyed compiled caches and ``self.plan`` for shardings.
+
+    TPU elements are ``device_resident``: outputs may stay un-synced
+    ``jax.Array`` (the engine only syncs at sinks / the bounded dispatch
+    window), and event-loop execution runs under the pipeline's
+    transfer guard (pipeline/overlap.py).
     """
+
+    device_resident = True
 
     def __init__(self, context):
         super().__init__(context)

@@ -33,16 +33,21 @@ _logger = get_logger("aiko.profiling")
 class Profiler:
     """Process-wide trace plus per-element trace annotations.
 
-    The pipeline hot loop is single-threaded (one event engine owns all
-    element execution), so a plain stack of open annotations is enough;
-    a dangling annotation (element raised, so the post hook never fired)
-    is closed at the next enter or at ``detach()``.
+    With overlapped frame execution (async park/resume, cross-stream
+    micro-batching) element spans INTERLEAVE: frame k+1's detect enter
+    fires while frame k is still parked at the LLM, and the post hooks
+    resume in completion order, not a stack order.  Spans are therefore
+    keyed by (element, stream, frame) -- each ``TraceAnnotation`` is an
+    independent timed event, so out-of-order exits are fine.  A
+    dangling annotation (element raised, so the post hook never fired)
+    is closed when the same (element, frame) re-enters (frame retry) or
+    at ``detach()``.
     """
 
     def __init__(self):
         self._logdir: str | None = None
         self._pipelines: list = []
-        self._open: list[jax.profiler.TraceAnnotation] = []
+        self._open: dict = {}  # (element, stream, frame) -> annotation
 
     @property
     def active(self) -> bool:
@@ -84,20 +89,31 @@ class Profiler:
         self._pipelines.clear()
         self._unwind()
 
+    @staticmethod
+    def _key(variables):
+        # Stream id included: frame ids restart per stream, so two
+        # overlapping streams' frame 5 must not share a span.
+        return (variables.get("element"), variables.get("stream"),
+                variables.get("frame"))
+
     def _on_element(self, component, hook, variables):
-        self._unwind()          # close a dangling span (element raised)
-        annotation = jax.profiler.TraceAnnotation(
-            f"element:{variables.get('element')}")
+        key = self._key(variables)
+        stale = self._open.pop(key, None)
+        if stale is not None:   # same frame re-entered: close the
+            stale.__exit__(None, None, None)    # dangling span
+        annotation = jax.profiler.TraceAnnotation(f"element:{key[0]}")
         annotation.__enter__()
-        self._open.append(annotation)
+        self._open[key] = annotation
 
     def _on_element_post(self, component, hook, variables):
-        if self._open:
-            self._open.pop().__exit__(None, None, None)
+        annotation = self._open.pop(self._key(variables), None)
+        if annotation is not None:
+            annotation.__exit__(None, None, None)
 
     def _unwind(self):
         while self._open:
-            self._open.pop().__exit__(None, None, None)
+            _, annotation = self._open.popitem()
+            annotation.__exit__(None, None, None)
 
 
 @contextlib.contextmanager

@@ -726,7 +726,12 @@ def bench_pipeline_e2e() -> dict:
     definition = {
         "version": 0, "name": "bench_e2e", "runtime": "jax",
         "graph": ["(DET (CAP (LLM)))"],
-        "parameters": {},
+        # transfer_guard=disallow: an implicit host sync on the
+        # device-element path FAILS the run (and shows up in
+        # swag_host_transfers) instead of silently halving fps;
+        # device_inflight=3 bounds async dispatch at triple buffering.
+        "parameters": {"transfer_guard": "disallow",
+                       "device_inflight": 3},
         "elements": [
             element("DET", "Detector", ["image"],
                     ["image", "overlay", "detections"],
@@ -825,6 +830,7 @@ def bench_pipeline_e2e() -> dict:
         runtime.terminate()
         return {"pipeline_e2e_error": error}
     elapsed, snapshot = best
+    host_elapsed, host_snapshot = elapsed, snapshot
 
     def p50(key, rows=None):
         values = sorted(metrics.get(key, 0.0)
@@ -865,15 +871,36 @@ def bench_pipeline_e2e() -> dict:
     pump_device(E2E_WARMUP)
     runtime.run(until=lambda: drain(E2E_WARMUP), timeout=600.0)
     device_best, device_error = timed_best_of(3, pump_device)
+    # Device-resident swag accounting: implicit transfers (violations
+    # of the residency contract -- 0 when healthy; the run FAILS under
+    # transfer_guard=disallow if one sneaks onto the device path) and
+    # engine-explicit counted fetches.
+    transfer = pipeline.transfer_stats()
+    result["swag_host_transfers"] = transfer["implicit"]
+    result["swag_explicit_fetches"] = transfer["explicit"]
     runtime.terminate()
     if device_best is None:
         result["pipeline_e2e_device_error"] = device_error
         return result
     elapsed, snapshot = device_best
+    device_fps = len(snapshot) / elapsed
     result.update({
-        "pipeline_e2e_device_fps": round(len(snapshot) / elapsed, 2),
+        "pipeline_e2e_device_fps": round(device_fps, 2),
         "pipeline_e2e_device_p50_ms": round(
             p50("time_pipeline", snapshot) * 1000, 1)})
+    # Host/device gap, whole-pipeline and per-element: the per-frame
+    # cost the host-driven path pays over the device-resident path
+    # (uploads, host mapping, response marshalling).  The per-element
+    # keys localize a regression to the stage that grew it.
+    host_fps = len(host_snapshot) / host_elapsed
+    if host_fps > 0 and device_fps > 0:
+        result["pipeline_e2e_host_overhead_ms"] = round(
+            (1.0 / host_fps - 1.0 / device_fps) * 1000, 1)
+    for element_name in ("DET", "CAP", "LLM"):
+        gap = (p50(f"{element_name}_time", host_snapshot)
+               - p50(f"{element_name}_time", snapshot))
+        result[f"pipeline_e2e_gap_{element_name.lower()}_ms"] = round(
+            gap * 1000, 2)
     return result
 
 

@@ -1,0 +1,495 @@
+"""Overlapped frame execution (ISSUE 1): device-resident swag between
+consecutive device elements, the transfer-guard ledger, the bounded
+per-stream dispatch window, and cross-stream micro-batching.
+
+The transfer-guard contract is enforced two ways: the real
+``jax.transfer_guard`` wraps device elements (effective on TPU, where a
+device->host copy is a transfer), and a software residency check
+catches declared-``tensor`` outputs arriving host-side -- which is what
+fires on this CPU backend, where d2h is zero-copy and the jax guard
+never trips.  These tests run small pipelines under
+``transfer_guard: disallow`` so a host-sync regression on the
+device-element path fails fast here in tier-1, not on hardware.
+"""
+
+import json
+import queue
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from conftest import run_until
+
+from aiko_services_tpu.pipeline import (PipelineElement, StreamEvent,
+                                        create_pipeline)
+from aiko_services_tpu.pipeline.codec import (decode_frame_data,
+                                              decode_value,
+                                              encode_frame_data,
+                                              encode_value)
+
+DELAY = 0.05
+
+# (element name, arrived-as-jax.Array) per process_frame call, so tests
+# can assert values stayed device-resident BETWEEN elements.
+ARRIVALS: list = []
+
+
+class DeviceUpload(PipelineElement):
+    """Head element: host value -> device array (one explicit upload)."""
+
+    device_resident = True
+
+    def process_frame(self, stream, x=None, **inputs):
+        return StreamEvent.OKAY, {"x": jnp.asarray(x)}
+
+
+class DeviceDouble(PipelineElement):
+    """Device stage: consumes and produces jax.Array, never syncing."""
+
+    device_resident = True
+
+    def process_frame(self, stream, x=None, **inputs):
+        ARRIVALS.append((self.name, isinstance(x, jax.Array)))
+        return StreamEvent.OKAY, {"x": jnp.asarray(x) * 2}
+
+
+class HostSink(PipelineElement):
+    """Host stage (``host_inputs``): the engine fetches explicitly."""
+
+    host_inputs = ("x",)
+
+    def process_frame(self, stream, x=None, **inputs):
+        ARRIVALS.append((self.name, isinstance(x, jax.Array)))
+        return StreamEvent.OKAY, {"total": float(np.asarray(x).sum())}
+
+
+class LeakyDevice(PipelineElement):
+    """Regression stand-in: a device element that fetches its declared
+    device output to host (the np.asarray-per-row class of bug)."""
+
+    device_resident = True
+
+    def process_frame(self, stream, x=None, **inputs):
+        return StreamEvent.OKAY, {"x": np.asarray(jnp.asarray(x) * 2)}
+
+
+class AsyncDevice(PipelineElement):
+    """Async device stage with a fixed service delay: dispatches device
+    work immediately (the output array is handed over un-synced) and
+    completes ``delay`` seconds later -- an accelerator stage."""
+
+    device_resident = True
+    is_async = True
+
+    def process_frame_start(self, stream, complete, x=None, **inputs):
+        y = jnp.asarray(x) + 1
+        delay, _ = self.get_parameter("delay", DELAY)
+        threading.Timer(float(delay),
+                        lambda: complete(StreamEvent.OKAY, {"x": y})).start()
+
+
+def _definition(tmp_path, elements, graph, parameters=None,
+                types=None):
+    """elements: [(name, class_name, element_params)]; all single
+    input/output ``x`` unless ``types`` overrides the output type."""
+    body = {
+        "version": 0, "name": "overlap", "runtime": "jax",
+        "graph": graph, "parameters": parameters or {},
+        "elements": [
+            {"name": name,
+             "input": [{"name": "x"}],
+             "output": [{"name": "x",
+                         "type": (types or {}).get(name, "any")}],
+             "parameters": params or {},
+             "deploy": {"local": {"module": "test_overlap",
+                                  "class_name": cls}}}
+            for name, cls, params in elements]}
+    path = tmp_path / "overlap.json"
+    path.write_text(json.dumps(body))
+    return str(path)
+
+
+def _pump(pipeline, stream, values):
+    for value in values:
+        pipeline.create_frame_local(stream, {"x": value})
+
+
+# -- device-resident swag between consecutive device elements -----------
+
+def test_swag_stays_device_resident_between_device_elements(
+        tmp_path, runtime):
+    ARRIVALS.clear()
+    responses = queue.Queue()
+    pipeline = create_pipeline(
+        _definition(tmp_path,
+                    [("up", "DeviceUpload", {}),
+                     ("d1", "DeviceDouble", {}),
+                     ("d2", "DeviceDouble", {})],
+                    ["(up d1 d2)"],
+                    parameters={"transfer_guard": "disallow"}),
+        runtime=runtime)
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    _pump(pipeline, stream, [np.arange(4, dtype=np.float32)])
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    # Both device stages saw a jax.Array -- no host round trip between
+    # consecutive device elements...
+    assert ARRIVALS == [("d1", True), ("d2", True)]
+    # ...and the local response still passes by reference, device-side.
+    assert isinstance(swag["x"], jax.Array)
+    np.testing.assert_allclose(np.asarray(swag["x"]),
+                               np.arange(4, dtype=np.float32) * 4)
+    # Transfer-guard counter == 0: nothing implicit, nothing fetched.
+    stats = pipeline.transfer_stats()
+    assert stats["implicit"] == 0
+    assert stats["explicit"] == 0
+    pipeline.stop()
+
+
+def test_host_typed_input_is_fetched_explicitly(tmp_path, runtime):
+    ARRIVALS.clear()
+    responses = queue.Queue()
+    pipeline = create_pipeline(
+        _definition(tmp_path,
+                    [("up", "DeviceUpload", {}),
+                     ("sink", "HostSink", {})],
+                    ["(up sink)"],
+                    parameters={"transfer_guard": "disallow"}),
+        runtime=runtime)
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    _pump(pipeline, stream, [np.arange(4, dtype=np.float32)])
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    assert swag["total"] == 6.0
+    assert ARRIVALS == [("sink", False)]    # materialized host-side
+    stats = pipeline.transfer_stats()
+    assert stats["explicit"] == 1           # ONE counted engine fetch
+    assert stats["implicit"] == 0
+    pipeline.stop()
+
+
+def test_transfer_guard_disallow_fails_host_sync_fast(tmp_path, runtime):
+    """The tier-1 regression tripwire: a device element whose declared
+    device output comes back host-resident must FAIL the frame under
+    ``transfer_guard: disallow`` (and count), not silently halve fps."""
+    responses = queue.Queue()
+    pipeline = create_pipeline(
+        _definition(tmp_path,
+                    [("up", "DeviceUpload", {}),
+                     ("leak", "LeakyDevice", {})],
+                    ["(up leak)"],
+                    parameters={"transfer_guard": "disallow"},
+                    types={"leak": "tensor"}),
+        runtime=runtime)
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    _pump(pipeline, stream, [np.arange(4, dtype=np.float32)])
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    _, _, _, _, okay, diagnostic = responses.get()
+    assert not okay
+    assert "transfer_guard" in diagnostic
+    assert pipeline.transfer_stats()["implicit"] == 1
+    pipeline.stop()
+
+
+def test_transfer_guard_log_records_without_failing(tmp_path, runtime):
+    responses = queue.Queue()
+    pipeline = create_pipeline(
+        _definition(tmp_path,
+                    [("up", "DeviceUpload", {}),
+                     ("leak", "LeakyDevice", {})],
+                    ["(up leak)"],
+                    parameters={"transfer_guard": "log"},
+                    types={"leak": "tensor"}),
+        runtime=runtime)
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    _pump(pipeline, stream, [np.arange(4, dtype=np.float32)])
+    assert run_until(runtime, lambda: not responses.empty(), timeout=10.0)
+    *_, okay, diagnostic = responses.get()
+    assert okay, diagnostic                 # recorded, not failed
+    assert pipeline.transfer_stats()["implicit"] == 1
+    pipeline.stop()
+
+
+# -- the overlap window (two streams, two device elements) ---------------
+
+def test_frames_overlap_across_device_stages_two_streams(
+        tmp_path, runtime):
+    """Satellite: a two-stream, two-device-element pipeline where (a)
+    nothing transfers implicitly (counter == 0) and (b) frame k+1's
+    first element STARTS before frame k's last element COMPLETES --
+    proven from the engine's absolute per-element start stamps."""
+    frames_per_stream = 3
+    definition = _definition(
+        tmp_path,
+        [("a", "AsyncDevice", {}), ("b", "AsyncDevice", {})],
+        ["(a b)"],
+        parameters={"transfer_guard": "disallow"})
+    pipeline = create_pipeline(definition, runtime=runtime)
+    collected: dict = {"s1": [], "s2": []}
+    queues = {}
+    for stream_id in collected:
+        queues[stream_id] = queue.Queue()
+        stream = pipeline.create_stream_local(
+            stream_id, queue_response=queues[stream_id])
+        _pump(pipeline, stream,
+              [np.full((8,), i, dtype=np.float32)
+               for i in range(frames_per_stream)])
+    assert run_until(
+        runtime,
+        lambda: all(queues[s].qsize() >= frames_per_stream
+                    for s in queues),
+        timeout=30.0)
+    for stream_id, rows in collected.items():
+        while not queues[stream_id].empty():
+            _, frame_id, swag, metrics, okay, diagnostic = \
+                queues[stream_id].get()
+            assert okay, diagnostic
+            assert isinstance(swag["x"], jax.Array)  # stayed device
+            rows.append((frame_id, metrics))
+        rows.sort()
+        assert len(rows) == frames_per_stream
+        for (_, earlier), (_, later) in zip(rows, rows[1:]):
+            k_last_done = earlier["b_time_start"] + earlier["b_time"]
+            assert later["a_time_start"] < k_last_done, (
+                f"stream {stream_id}: frame k+1's first element "
+                f"started {later['a_time_start'] - k_last_done:.3f}s "
+                f"AFTER frame k's last element completed -- no overlap")
+    stats = pipeline.transfer_stats()
+    assert stats["implicit"] == 0           # (a) nothing transferred
+    pipeline.stop()
+
+
+# -- bounded dispatch window --------------------------------------------
+
+def test_device_window_bounds_inflight_dispatch(tmp_path, runtime):
+    frames = 6
+    limit = 2
+    responses = queue.Queue()
+    pipeline = create_pipeline(
+        _definition(tmp_path,
+                    [("up", "DeviceUpload", {}),
+                     ("d1", "DeviceDouble", {})],
+                    ["(up d1)"],
+                    parameters={"device_inflight": limit}),
+        runtime=runtime)
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    _pump(pipeline, stream,
+          [np.arange(4, dtype=np.float32)] * frames)
+    assert run_until(runtime, lambda: responses.qsize() >= frames,
+                     timeout=15.0)
+    window = stream.device_window
+    # Every completed frame carried device leaves into the window; the
+    # pacing kept at most `limit` outstanding and synced the rest.
+    assert window.noted == frames
+    assert window.outstanding <= limit
+    assert window.synced >= frames - limit
+    pipeline.stop()
+
+
+def test_device_window_disabled_never_paces(tmp_path, runtime):
+    responses = queue.Queue()
+    pipeline = create_pipeline(
+        _definition(tmp_path,
+                    [("up", "DeviceUpload", {}),
+                     ("d1", "DeviceDouble", {})],
+                    ["(up d1)"],
+                    parameters={"device_inflight": 0}),
+        runtime=runtime)
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    _pump(pipeline, stream, [np.arange(4, dtype=np.float32)] * 4)
+    assert run_until(runtime, lambda: responses.qsize() >= 4,
+                     timeout=15.0)
+    assert stream.device_window.synced == 0
+    pipeline.stop()
+
+
+# -- cross-stream micro-batching (MicroBatcher elements) -----------------
+
+def _media_definition(tmp_path, name, cls, module, inputs, outputs,
+                      params):
+    body = {
+        "version": 0, "name": f"mb_{name}", "runtime": "jax",
+        "graph": [f"({name})"], "parameters": {},
+        "elements": [{
+            "name": name,
+            "input": [{"name": n} for n in inputs],
+            "output": [{"name": n} for n in outputs],
+            "parameters": params,
+            "deploy": {"local": {"module": module, "class_name": cls}}}]}
+    path = tmp_path / f"{name}.json"
+    path.write_text(json.dumps(body))
+    return str(path)
+
+
+def test_resize_microbatches_across_streams(tmp_path, runtime):
+    """Frames parked at ImageResize from TWO streams resize as ONE
+    batched dispatch, each getting its own row -- identical to the
+    blocking path -- and the rows stay device-resident."""
+    definition = _media_definition(
+        tmp_path, "resize", "ImageResize",
+        "aiko_services_tpu.elements.image", ["image"], ["image"],
+        {"width": 16, "height": 16})
+    pipeline = create_pipeline(definition, runtime=runtime)
+    rng = np.random.default_rng(0)
+    images = {f"s{i}": rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+              for i in range(2)}
+    queues = {}
+    for stream_id, image in images.items():
+        queues[stream_id] = queue.Queue()
+        stream = pipeline.create_stream_local(
+            stream_id, queue_response=queues[stream_id])
+        pipeline.create_frame_local(stream, {"image": image})
+    assert run_until(runtime,
+                     lambda: all(not q.empty() for q in queues.values()),
+                     timeout=30.0)
+    element = pipeline.graph.get_node("resize").element
+    assert element._batcher.dispatches == 1, (
+        f"{element._batcher.dispatches} dispatches for 2 frames: "
+        f"not cross-stream batched")
+    for stream_id, image in images.items():
+        _, _, swag, _, okay, diagnostic = queues[stream_id].get()
+        assert okay, diagnostic
+        resized = swag["image"]
+        assert isinstance(resized, jax.Array)       # device-resident
+        assert resized.shape == (16, 16, 3)
+        _, sync_out = element.process_frame(None, image=image)
+        np.testing.assert_array_equal(np.asarray(resized),
+                                      np.asarray(sync_out["image"]))
+    pipeline.stop()
+
+
+def test_audio_fft_microbatch_matches_sync(tmp_path, runtime):
+    definition = _media_definition(
+        tmp_path, "fft", "AudioFFT",
+        "aiko_services_tpu.elements.audio",
+        ["frames", "sample_rate"], ["spectrum", "sample_rate"], {})
+    pipeline = create_pipeline(definition, runtime=runtime)
+    rng = np.random.default_rng(1)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    windows = [rng.standard_normal((4, 64, 1)).astype(np.float32)
+               for _ in range(3)]
+    for w in windows:
+        pipeline.create_frame_local(
+            stream, {"frames": w, "sample_rate": 16000})
+    assert run_until(runtime, lambda: responses.qsize() >= 3,
+                     timeout=30.0)
+    element = pipeline.graph.get_node("fft").element
+    assert element._batcher.dispatches < 3      # coalesced
+    by_frame = {}
+    while not responses.empty():
+        _, frame_id, swag, _, okay, diagnostic = responses.get()
+        assert okay, diagnostic
+        by_frame[frame_id] = swag
+    for frame_id, w in enumerate(windows):
+        _, sync_out = element.process_frame(None, frames=w)
+        np.testing.assert_allclose(
+            np.asarray(by_frame[frame_id]["spectrum"]),
+            np.asarray(sync_out["spectrum"]), rtol=1e-5, atol=1e-5)
+    pipeline.stop()
+
+
+def test_audio_fft_accepts_array_like_frames(tmp_path, runtime):
+    """Plain nested lists -- anything ``jnp.asarray`` accepts -- must
+    still work through the async micro-batched path (the sync path
+    always took them)."""
+    definition = _media_definition(
+        tmp_path, "fft", "AudioFFT",
+        "aiko_services_tpu.elements.audio",
+        ["frames", "sample_rate"], ["spectrum", "sample_rate"], {})
+    pipeline = create_pipeline(definition, runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    frames = [[0.0, 1.0, 0.0, -1.0]] * 2        # [2 windows, 4 samples]
+    pipeline.create_frame_local(
+        stream, {"frames": frames, "sample_rate": 16000})
+    assert run_until(runtime, lambda: not responses.empty(), timeout=30.0)
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    element = pipeline.graph.get_node("fft").element
+    _, sync_out = element.process_frame(None, frames=frames)
+    np.testing.assert_allclose(np.asarray(swag["spectrum"]),
+                               np.asarray(sync_out["spectrum"]),
+                               rtol=1e-5, atol=1e-5)
+    pipeline.stop()
+
+
+def test_detector_mixed_dtype_burst_normalizes_each_group(
+        tmp_path, runtime):
+    """A uint8 frame and a float32 frame of the same shape submitted in
+    one burst must EACH match their own blocking-path output: raw-dtype
+    grouping keeps the /255 normalization per group (regression: a
+    shared key let the stacked batch promote to float32 and the uint8
+    rows skipped normalization)."""
+    definition = _media_definition(
+        tmp_path, "detect", "Detector",
+        "aiko_services_tpu.elements.detect", ["image"], ["detections"],
+        {"width": 4})
+    pipeline = create_pipeline(definition, runtime=runtime)
+    rng = np.random.default_rng(0)
+    u8 = rng.integers(0, 255, (64, 64, 3)).astype(np.uint8)
+    f32 = rng.random((64, 64, 3)).astype(np.float32)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    pipeline.create_frame_local(stream, {"image": u8})
+    pipeline.create_frame_local(stream, {"image": f32})
+    assert run_until(runtime, lambda: responses.qsize() >= 2,
+                     timeout=60.0)
+    element = pipeline.graph.get_node("detect").element
+    by_frame = {}
+    while not responses.empty():
+        _, frame_id, swag, _, okay, diagnostic = responses.get()
+        assert okay, diagnostic
+        by_frame[frame_id] = swag["detections"]
+    for frame_id, image in enumerate((u8, f32)):
+        _, sync_out = element.process_frame(stream, image=image)
+        assert by_frame[frame_id] == sync_out["detections"]
+    pipeline.stop()
+
+
+# -- codec round trips (process-boundary satellite) ----------------------
+
+def test_codec_roundtrip_zero_dim_scalars():
+    for value in (jnp.float32(3.5), jnp.int32(-7),
+                  np.float64(2.25), jnp.bfloat16(1.5)):
+        decoded = decode_value(encode_value(value))
+        assert decoded.shape == ()
+        assert decoded.dtype == np.asarray(value).dtype
+        np.testing.assert_allclose(np.asarray(decoded, dtype=np.float64),
+                                   float(value))
+
+
+def test_codec_roundtrip_bf16_arrays():
+    array = jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3) / 4
+    decoded = decode_value(encode_value(array))
+    assert decoded.dtype == np.asarray(array).dtype    # bfloat16 kept
+    assert decoded.shape == (2, 3)
+    np.testing.assert_array_equal(decoded, np.asarray(array))
+
+
+def test_codec_plain_void_dtype_falls_back_to_npy():
+    """Unstructured void dtypes that are NOT ml_dtypes extensions keep
+    the plain npy path (lossy dtype but no crash), as before."""
+    value = np.zeros(4, dtype="V3")
+    encoded = encode_value(value)
+    assert isinstance(encoded, str) and encoded.startswith("npy64:")
+
+
+def test_codec_roundtrip_nested_frame_data():
+    frame = {"image": np.zeros((2, 2, 3), dtype=np.uint8),
+             "logits": jnp.ones((4,), dtype=jnp.bfloat16),
+             "meta": {"names": ["a", "b"], "score": 0.5},
+             "rows": [jnp.float32(1.0), "text"]}
+    decoded = decode_frame_data(encode_frame_data(frame))
+    assert decoded["meta"] == {"names": ["a", "b"], "score": 0.5}
+    assert decoded["rows"][1] == "text"
+    assert decoded["logits"].dtype == np.asarray(frame["logits"]).dtype
+    np.testing.assert_array_equal(decoded["image"], frame["image"])
+    np.testing.assert_array_equal(decoded["rows"][0],
+                                  np.asarray(frame["rows"][0]))

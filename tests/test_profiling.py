@@ -41,7 +41,7 @@ def test_element_annotations_balanced(runtime, tmp_path):
         _run_frame(runtime, pipeline, {"a": 2})
     finally:
         profiler.detach()
-        assert profiler._open == []     # every span closed
+        assert not profiler._open       # every span closed
         profiler.stop()
     assert not profiler.active
     # post hook fired once per element per frame
@@ -62,8 +62,9 @@ def test_profile_trace_context_manager(runtime, tmp_path):
 
 
 def test_dangling_annotation_unwound(runtime, tmp_path):
-    """An element that raises skips the post hook; the profiler must not
-    leak the open span into the next element."""
+    """An element that raises must not leak its open span into later
+    elements (the engine pairs the enter hook with an ERROR post on
+    failure paths; detach unwinds anything that still dangles)."""
     definition = _definition()
     definition["elements"][1]["deploy"]["local"]["class_name"] = "Raiser"
     definition["graph"] = ["(A B)"]
@@ -74,4 +75,4 @@ def test_dangling_annotation_unwound(runtime, tmp_path):
         run_until(runtime, lambda: not responses.empty())
         assert len(profiler._open) <= 1      # only B's dangling span
         _run_frame(runtime, pipeline, {"a": 1})
-    assert profiler._open == []
+    assert not profiler._open
