@@ -38,6 +38,8 @@ _transport_option = click.option(
 _HOOK_ALIASES = {"pf": "pipeline.process_frame:0",
                  "pe": "pipeline.process_element:0",
                  "pep": "pipeline.process_element_post:0",
+                 "ps": "pipeline.process_segment:0",
+                 "psp": "pipeline.process_segment_post:0",
                  "rp": "pipeline.replacement:0"}
 
 

@@ -162,6 +162,16 @@ class AudioFFT(MicroBatchElement, PipelineElement):
             "spectrum": self._spectrum(jnp.asarray(frames)),
             "sample_rate": sample_rate}
 
+    def device_fn(self, stream):
+        """Fused-segment contract: the FFT is pure device math;
+        ``sample_rate`` is not consumed by the trace, so the engine
+        passes it through host-side unchanged (type preserved)."""
+        from ..pipeline import DeviceFn
+        return DeviceFn(
+            fn=lambda frames: {
+                "spectrum": self._spectrum(jnp.asarray(frames))},
+            inputs=("frames",), outputs=("spectrum",))
+
     def process_frame_start(self, stream, complete, frames=None,
                             sample_rate=16000, **inputs):
         self.submit_microbatch(complete, (frames, sample_rate),

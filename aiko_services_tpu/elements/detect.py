@@ -138,6 +138,30 @@ class Detector(MicroBatchElement, TPUElement):
         """Enqueue the jitted detect (asynchronous on the device)."""
         return self._detect(self._params, self._preprocess(image)[None])
 
+    def device_fn(self, stream):
+        """Fused-segment contract (with ``synchronous: true``): the
+        forward+decode+NMS slate is pure device math, traced into the
+        segment with the weights as captured args (never baked-in
+        constants); the overlay/detections postprocess is the host
+        ``finalize`` step, fed by ONE engine-counted fetch of the slate
+        at the segment boundary -- which also makes a synchronous
+        fused Detector legal under ``transfer_guard: disallow``."""
+        from ..pipeline import DeviceFn
+        self._ensure_model()
+        config = self._config
+
+        def fn(image, params):
+            batch = self._preprocess(jnp.asarray(image))[None]
+            return dict(detector.detect.__wrapped__(params, config,
+                                                    batch))
+
+        return DeviceFn(
+            fn=fn, inputs=("image",),
+            captures={"params": self._params},
+            finalize=lambda fetched: self._slate_outputs(fetched, 0),
+            finalize_inputs=("boxes", "scores", "classes", "valid"),
+            finalize_outputs=("overlay", "detections"))
+
     # -- async micro-batched path ------------------------------------------
 
     def process_frame_start(self, stream, complete, image=None, **inputs):
@@ -195,6 +219,9 @@ class Detector(MicroBatchElement, TPUElement):
         return StreamEvent.OKAY, self._postprocess(image, result)
 
     def _postprocess(self, image, fetched: dict, row: int = 0) -> dict:
+        return {"image": image, **self._slate_outputs(fetched, row)}
+
+    def _slate_outputs(self, fetched: dict, row: int = 0) -> dict:
         """Build overlay/detections from the HOST-fetched result dict
         (callers did the one ``jax.device_get``; nothing here touches
         the device)."""
@@ -220,6 +247,5 @@ class Detector(MicroBatchElement, TPUElement):
             detections.append({"class": name,
                                "score": float(scores[i]),
                                "box": [x1, y1, x2, y2]})
-        return {"image": image,
-                "overlay": {"rectangles": rectangles},
+        return {"overlay": {"rectangles": rectangles},
                 "detections": detections}

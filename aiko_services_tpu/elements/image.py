@@ -140,6 +140,22 @@ class ImageResize(MicroBatchElement, PipelineElement):
         return StreamEvent.OKAY, {
             "image": self._resize_one(image, int(height), int(width))}
 
+    def device_fn(self, stream):
+        """Fused-segment contract: with ``synchronous: true`` the resize
+        is a pure device computation, so a chain of device stages
+        around it compiles into ONE dispatch (pipeline/fusion.py)."""
+        from ..pipeline import DeviceFn
+        width, _ = self.get_parameter("width")
+        height, _ = self.get_parameter("height")
+        if not width or not height:
+            return None
+        height, width = int(height), int(width)
+        return DeviceFn(
+            fn=lambda image: {
+                "image": self._resize_one(jnp.asarray(image),
+                                          height, width)},
+            inputs=("image",), outputs=("image",))
+
     def process_frame_start(self, stream, complete, image=None, **inputs):
         self.submit_microbatch(complete, image, diagnostic="bad image")
 

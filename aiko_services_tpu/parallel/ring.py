@@ -31,12 +31,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-try:                                    # jax >= 0.8 re-exports at top level
-    from jax import shard_map as _shard_map
-except ImportError:                     # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-from .mesh import P
+from .mesh import P, shard_map as _shard_map
 
 __all__ = ["ring_attention", "ulysses_attention", "blockwise_attention",
            "ring_attention_sharded"]
@@ -162,7 +157,7 @@ def ring_attention(q, k, v, q_positions, mesh, axis: str = "sp",
     return _shard_map(
         inner, mesh=mesh,
         in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_pos, spec_pos),
-        out_specs=spec_qkv, check_vma=False,
+        out_specs=spec_qkv, check=False,
     )(q, k, v, q_positions, kv_positions)
 
 
@@ -214,7 +209,7 @@ def ulysses_attention(q, k, v, q_positions, mesh, axis: str = "sp",
     return _shard_map(
         inner, mesh=mesh,
         in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_pos, spec_pos),
-        out_specs=spec_qkv, check_vma=False,
+        out_specs=spec_qkv, check=False,
     )(q, k, v, q_positions, kv_positions)
 
 

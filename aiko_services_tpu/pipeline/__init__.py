@@ -10,5 +10,7 @@ from .scheme import DataScheme, DataSource, DataTarget, contains_all
 from .codec import (encode_frame_data, decode_frame_data, encode_value,
                     decode_value)
 from .overlap import TransferLedger, DeviceWindow, device_leaves
+from .fusion import (DeviceFn, FusedSegment, FusionError, FUSE_MODES,
+                     setup_compilation_cache)
 from .tensor import (TPUElement, JitCache, ShapeBucketer, StagePlacement,
                      encode_array, decode_array, tree_device_put)

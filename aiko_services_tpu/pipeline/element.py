@@ -112,6 +112,23 @@ class PipelineElement:
     def compile_element(self, stream: Stream):
         """Optional: warm jit caches for this stream's shapes."""
 
+    def device_fn(self, stream: Stream):
+        """Fused-segment contract (pipeline/fusion.py): return a
+        :class:`~.fusion.DeviceFn` describing this element's pure device
+        computation, or None (default) when the element cannot fuse.
+
+        Declaring one promises that, for this stream's parameters, the
+        element's work is equivalent to ``fn(**inputs, **captures) ->
+        outputs dict`` traced under ``jax.jit``: no host syncs, no IO,
+        no StreamEvent control flow (fused execution always maps the
+        results out as OKAY), and any host-side postprocessing expressed
+        as the DeviceFn's ``finalize`` step.  The engine may then splice
+        this element into a fused segment -- one XLA dispatch for the
+        whole chain -- whenever it sits in a run of device-pure
+        elements (no ``host_inputs``, no async/micro-batch park, no
+        placement stage hop)."""
+        return None
+
     # -- parameters --------------------------------------------------------
 
     def get_parameter(self, name: str, default=None,

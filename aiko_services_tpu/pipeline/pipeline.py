@@ -31,6 +31,8 @@ from .codec import decode_frame_data, encode_frame_data
 from .definition import (PipelineDefinition, parse_pipeline_definition,
                          load_pipeline_definition, DefinitionError)
 from .element import ElementContext, PipelineElement, PipelineElementLoop
+from .fusion import (FUSE_MODES, FusedSegment, partition,
+                     setup_compilation_cache)
 from .overlap import DEVICE_INFLIGHT_DEFAULT, TransferLedger
 from .stream import (Stream, Frame, StreamEvent, StreamState,
                      DEFAULT_STREAM_ID)
@@ -103,6 +105,12 @@ class Pipeline(Actor):
         # device-resident element's event-loop execution.
         self.transfer_ledger = TransferLedger(
             definition.parameters.get("transfer_guard", "allow"))
+        # Fused device-segment compilation (pipeline/fusion.py): every
+        # FusedSegment built for this pipeline's streams registers here
+        # (jit_stats / bench counters); the persistent XLA compile
+        # cache is wired once per process, env-gated.
+        self.fused_segments: list[FusedSegment] = []
+        setup_compilation_cache(definition.parameters)
         self.stage_placement = self._build_placement()
         self.graph = self._build_graph()
         self.share["element_count"] = len(self.graph)
@@ -113,6 +121,8 @@ class Pipeline(Actor):
         self.add_hook("pipeline.process_frame:0")
         self.add_hook("pipeline.process_element:0")
         self.add_hook("pipeline.process_element_post:0")
+        self.add_hook("pipeline.process_segment:0")
+        self.add_hook("pipeline.process_segment_post:0")
         self.add_hook("pipeline.replacement:0")
 
         self._health_timer = None
@@ -206,6 +216,13 @@ class Pipeline(Actor):
             element = node.element
             if isinstance(element, TPUElement):
                 element.on_replacement()
+        # Fused segments captured the old weights/devices at build time:
+        # drop every stream's partition so the next frame re-plans (and
+        # re-captures) against the replacement submeshes.
+        for stream in self.streams.values():
+            stream.fusion_plans.clear()
+            stream.fusion_segments.clear()
+        self.fused_segments.clear()
         self.run_hook("pipeline.replacement:0",
                       lambda: {"failed": [str(d) for d in failed_devices],
                                "generation": placement.generation,
@@ -287,6 +304,44 @@ class Pipeline(Actor):
                            for stream_id, stream in self.streams.items()}
         return stats
 
+    def jit_stats(self) -> dict:
+        """Compiled-function cache accounting, transfer_stats()-style:
+        hit/miss/entry totals over every element JitCache and every
+        fused segment's call cache, with per-element / per-segment
+        breakdowns (the dashboard and bench read the totals off the
+        share dict as ``jit_cache_{hits,misses,entries}``)."""
+        totals = {"hits": 0, "misses": 0, "entries": 0}
+        elements, segments = {}, {}
+        for node in self.graph.nodes():
+            cache = getattr(node.element, "jit_cache", None)
+            if cache is None:
+                continue
+            stats = cache.stats
+            elements[node.name] = stats
+            for key in totals:
+                totals[key] += stats[key]
+        for segment in self.fused_segments:
+            stats = segment.jit_cache.stats
+            # Segments are stream-owned; two streams running the same
+            # path each have one, so the breakdown keys by both.
+            label = segment.name if segment.stream_id is None \
+                else f"{segment.stream_id}:{segment.name}"
+            segments[label] = segment.stats
+            for key in totals:
+                totals[key] += stats[key]
+        totals["elements"] = elements
+        totals["segments"] = segments
+        return totals
+
+    def fusion_stats(self) -> dict:
+        """Fused-segment accounting: segment/dispatch totals the bench
+        reports as ``fused_segments`` / ``fused_dispatches_per_frame``."""
+        return {"segments": len(self.fused_segments),
+                "fused_elements": sum(len(s.nodes)
+                                      for s in self.fused_segments),
+                "dispatches": sum(s.calls for s in self.fused_segments),
+                "broken": sum(1 for s in self.fused_segments if s.broken)}
+
     # -- stream lifecycle --------------------------------------------------
 
     def create_stream(self, stream_id=None, *parameters):
@@ -329,6 +384,14 @@ class Pipeline(Actor):
                 "device_inflight",
                 self._pipeline_parameters.get("device_inflight")),
             DEVICE_INFLIGHT_DEFAULT))
+        fuse = str(stream.parameters.get(
+            "fuse", self._pipeline_parameters.get("fuse", "auto"))) \
+            .strip().lower()
+        if fuse not in FUSE_MODES:
+            self.logger.warning("stream %s: fuse=%r not one of %s; "
+                                "using auto", stream_id, fuse, FUSE_MODES)
+            fuse = "auto"
+        stream.fuse = fuse
         if grace_time:
             stream.lease = Lease(
                 self.runtime.engine, float(grace_time), stream_id,
@@ -418,6 +481,12 @@ class Pipeline(Actor):
         if stream.lease is not None:
             stream.lease.terminate()
         stream.device_window.clear()    # drop refs without blocking
+        # Fused segments are stream-owned (their captures/parameters
+        # resolved against this stream): release them with it, or the
+        # registry pins stale compiled calls (and captured weights)
+        # forever under churning streams.
+        self.fused_segments = [segment for segment in self.fused_segments
+                               if segment.stream_id != stream_id]
         self.share["swag_host_transfers"] = self.transfer_ledger.implicit
         self._current_stream_ref = stream
         try:
@@ -489,7 +558,7 @@ class Pipeline(Actor):
     # -- the hot loop ------------------------------------------------------
 
     def _process_frame_common(self, stream: Stream, frame: Frame,
-                              nodes=None):
+                              nodes=None, fuse=False):
         if stream.state not in (StreamState.START, StreamState.RUN):
             stream.frames.pop(frame.frame_id, None)
             return
@@ -497,15 +566,42 @@ class Pipeline(Actor):
         self.run_hook("pipeline.process_frame:0",
                       lambda: {"stream": stream.stream_id,
                                "frame": frame.frame_id})
+        # Fusion applies to full-path walks and to resume continuations
+        # that re-enter at a segment BOUNDARY (async/remote parks --
+        # those elements never join a segment, so the suffix partitions
+        # cleanly).  The retry paths pass fuse=False and execute
+        # per-element: a frame must never resume into the middle of a
+        # fused segment with half its outputs already mapped.
+        fuse = fuse or nodes is None
         if nodes is None:
             nodes = self._stream_path(stream)
         frame.metrics.setdefault("time_pipeline_start", time.perf_counter())
         self._current_stream_ref = stream
         swag = frame.swag
         try:
+            entries = self._fusion_entries(stream, nodes) if fuse \
+                else list(nodes)
             index = 0
-            while index < len(nodes):
-                node = nodes[index]
+            while index < len(entries):
+                entry = entries[index]
+                if isinstance(entry, FusedSegment):
+                    if entry.broken:
+                        # Poisoned (build/trace failed earlier): splice
+                        # the members back in permanently -- ``entries``
+                        # IS the cached plan, so later frames skip the
+                        # segment without re-failing.
+                        entries[index:index + 1] = entry.nodes
+                        continue
+                    outcome = self._run_fused_segment(stream, frame,
+                                                      entry)
+                    if outcome is None:
+                        return        # frame errored (and responded)
+                    if outcome is False:
+                        entries[index:index + 1] = entry.nodes
+                        continue      # fall back to per-element
+                    index += 1
+                    continue
+                node = entry
                 element = node.element
                 if isinstance(element, RemoteStage):
                     if self._forward_frame(stream, frame, node):
@@ -572,6 +668,9 @@ class Pipeline(Actor):
                     return
                 frame.metrics[f"{node.name}_time"] = \
                     time.perf_counter() - start
+                if element.device_resident:
+                    frame.metrics["device_dispatches"] = \
+                        frame.metrics.get("device_dispatches", 0) + 1
                 if _METRICS_MEMORY:
                     frame.metrics[f"{node.name}_memory"] = \
                         process_memory_rss() - rss_before
@@ -594,7 +693,7 @@ class Pipeline(Actor):
 
                 if event == StreamEvent.OKAY and isinstance(
                         element, PipelineElementLoop):
-                    self._map_out(node, swag, outputs)
+                    self._map_out(node, frame, outputs)
                     loop_start, found = element.get_parameter("loop_start")
                     if not found or loop_start not in self.graph:
                         self._frame_error(
@@ -602,17 +701,19 @@ class Pipeline(Actor):
                             f"{node.name}: bad loop_start {loop_start!r}")
                         return
                     nodes = self.graph.get_path(loop_start)
+                    entries = self._fusion_entries(stream, nodes) \
+                        if fuse else list(nodes)
                     index = 0
                     continue
                 if event in (StreamEvent.OKAY, StreamEvent.LOOP_END):
-                    self._map_out(node, swag, outputs)
+                    self._map_out(node, frame, outputs)
                     index += 1
                     continue
                 if event == StreamEvent.DROP_FRAME:
                     frame.metrics["dropped"] = True
                     break
                 if event == StreamEvent.STOP:
-                    self._map_out(node, swag, outputs)
+                    self._map_out(node, frame, outputs)
                     stream.state = StreamState.STOP
                     break
                 if event == StreamEvent.ERROR:
@@ -627,6 +728,129 @@ class Pipeline(Actor):
             self._frame_done(stream, frame, nodes)
         finally:
             self._current_stream_ref = None
+
+    # -- fused device segments (pipeline/fusion.py) ------------------------
+
+    def _fusion_entries(self, stream: Stream, nodes) -> list:
+        """The stream's fused execution plan for ``nodes``: Nodes and
+        FusedSegments, partitioned once per path and memoized on the
+        stream (``fuse: off`` short-circuits to the plain node list)."""
+        if stream.fuse == "off":
+            return list(nodes)
+        key = tuple(node.name for node in nodes)
+        plan = stream.fusion_plans.get(key)
+        if plan is None:
+            plan = partition(self, nodes, stream)
+            stream.fusion_plans[key] = plan
+            fused = [e for e in plan if isinstance(e, FusedSegment)]
+            if fused:
+                self.logger.info(
+                    "stream %s: fused %d segment(s): %s",
+                    stream.stream_id, len(fused),
+                    ", ".join(s.name for s in fused))
+        return plan
+
+    def _run_fused_segment(self, stream: Stream, frame: Frame,
+                           segment: FusedSegment):
+        """Execute a whole segment as ONE device dispatch.  Returns True
+        on success, None when the frame was errored, False to fall back
+        to per-element execution (first-call build/trace failure -- the
+        segment is poisoned so later frames skip it outright)."""
+        swag = frame.swag
+        resolved, missing = segment.resolve(swag)
+        if missing:
+            self._frame_error(stream, frame,
+                              f"{segment.name}: missing inputs {missing}")
+            return None
+        donated = segment.donate_keys(resolved, swag, frame.produced)
+        compiling = segment.would_compile(resolved, donated)
+        start = time.perf_counter()
+        for node in segment.nodes:
+            frame.metrics[f"{node.name}_time_start"] = start
+        self.run_hook("pipeline.process_segment:0",
+                      lambda: {"segment": segment.name,
+                               "elements": [n.name for n in segment.nodes],
+                               "stream": stream.stream_id,
+                               "frame": frame.frame_id,
+                               "compile": compiling})
+        ledger = self.transfer_ledger
+
+        def post_hook(event):
+            self.run_hook("pipeline.process_segment_post:0",
+                          lambda: {"segment": segment.name,
+                                   "stream": stream.stream_id,
+                                   "frame": frame.frame_id,
+                                   "event": event,
+                                   "compile": compiling,
+                                   "time": time.perf_counter() - start})
+
+        try:
+            if ledger.active:
+                # The whole segment is device-element event-loop work:
+                # one guard scope around the single dispatch.
+                with ledger.guard():
+                    out = segment.call(resolved, donated)
+            else:
+                out = segment.call(resolved, donated)
+        except Exception as error:
+            if ledger.is_guard_error(error):
+                ledger.record_implicit()
+            post_hook(StreamEvent.ERROR)
+            if compiling:
+                # Build/trace failure on a fresh signature: the fused
+                # path is an optimization, per-element execution is
+                # ground truth -- poison and fall back (a genuine data
+                # error will resurface there with a per-element
+                # diagnostic).
+                segment.broken = True
+                self.logger.exception(
+                    "segment %s: trace/compile failed; falling back to "
+                    "per-element execution", segment.name)
+                return False
+            self.logger.exception("segment %s raised", segment.name)
+            self._frame_error(stream, frame, f"{segment.name}: {error}")
+            return None
+        # Donated buffers are dead: drop the stale qualified aliases
+        # before map-out rewrites the bare keys, so nothing in the swag
+        # can reach an invalidated buffer (DeviceWindow syncs swag
+        # leaves at completion).
+        for key in donated:
+            swag.pop(f"{frame.produced[key]}.{key}", None)
+        try:
+            for step in segment.steps:
+                outputs = {}
+                for name in step.dfn.outputs:
+                    outputs[name] = out[f"{step.node.name}.{name}"]
+                for name, (kind, key) in step.pass_map.items():
+                    outputs[name] = out[key] if kind == "trace" \
+                        else resolved.get(key)
+                if step.dfn.finalize is not None:
+                    # The element's host postprocess: ONE counted fetch
+                    # of its device slate at the segment boundary.
+                    fetched = ledger.fetch(
+                        {name: out[f"{step.node.name}.{name}"]
+                         for name in step.dfn.finalize_inputs})
+                    outputs.update(step.dfn.finalize(fetched))
+                self._map_out(step.node, frame, outputs)
+                frame.metrics[f"{step.node.name}_time"] = 0.0
+        except Exception as error:
+            post_hook(StreamEvent.ERROR)
+            self.logger.exception("segment %s map-out failed",
+                                  segment.name)
+            self._frame_error(stream, frame, f"{segment.name}: {error}")
+            return None
+        elapsed = time.perf_counter() - start
+        # The single dispatch's wall time lands on the tail element (so
+        # per-element p50 keys stay populated); the members carry 0.0.
+        frame.metrics[f"{segment.nodes[-1].name}_time"] = elapsed
+        frame.metrics["fused_segments"] = \
+            frame.metrics.get("fused_segments", 0) + 1
+        frame.metrics["fused_elements"] = \
+            frame.metrics.get("fused_elements", 0) + len(segment.nodes)
+        frame.metrics["device_dispatches"] = \
+            frame.metrics.get("device_dispatches", 0) + 1
+        post_hook(StreamEvent.OKAY)
+        return True
 
     # -- local async stage park / submit / resume --------------------------
 
@@ -644,6 +868,9 @@ class Pipeline(Actor):
         node_name = node.name
         start = time.perf_counter()
         frame.metrics[f"{node_name}_time_start"] = start
+        if node.element.device_resident:
+            frame.metrics["device_dispatches"] = \
+                frame.metrics.get("device_dispatches", 0) + 1
         state = {"done": False}
         state_lock = threading.Lock()   # complete() may race itself
                                         # across threads; the resume
@@ -704,16 +931,20 @@ class Pipeline(Actor):
                                       outputs):
             return
         if event in (StreamEvent.OKAY, StreamEvent.LOOP_END):
-            self._map_out(node, frame.swag, outputs)
+            self._map_out(node, frame, outputs)
             nodes = self.graph.iterate_after(node_name, stream.graph_path)
-            self._process_frame_common(stream, frame, nodes=nodes)
+            # The async park site is a partition boundary, so the
+            # suffix re-enters the fused plan: device chains AFTER an
+            # async stage still run as single dispatches.
+            self._process_frame_common(stream, frame, nodes=nodes,
+                                       fuse=True)
             return
         if event == StreamEvent.DROP_FRAME:
             frame.metrics["dropped"] = True
             self._frame_done(stream, frame, None)
             return
         if event == StreamEvent.STOP:
-            self._map_out(node, frame.swag, outputs)
+            self._map_out(node, frame, outputs)
             stream.state = StreamState.STOP
             self._frame_done(stream, frame, None)
             return
@@ -726,7 +957,12 @@ class Pipeline(Actor):
         if stream is None:
             return
         stream.frames[frame.frame_id] = frame
-        self._process_frame_common(stream, frame)
+        # Replays run per-element (explicit node list): a prior attempt
+        # may have fused -- and donated -- its way through this swag, so
+        # the retry must not assume segment inputs still exist as the
+        # partitioner saw them.
+        self._process_frame_common(stream, frame,
+                                   nodes=self._stream_path(stream))
 
     def retry_frame_at(self, stream_id, frame: Frame, node_name: str):
         """Resume a frame at ``node_name`` (used when a remote stage was
@@ -812,10 +1048,14 @@ class Pipeline(Actor):
         return True
 
     @staticmethod
-    def _map_out(node, swag: dict, outputs: dict):
+    def _map_out(node, frame: Frame, outputs: dict):
+        swag = frame.swag
         for name, value in outputs.items():
             swag[name] = value
             swag[f"{node.name}.{name}"] = value
+            # Provenance for fused-segment donation: only values an
+            # element of THIS frame produced are ever donatable.
+            frame.produced[name] = node.name
 
     # -- completion / errors / responses ----------------------------------
 
@@ -830,6 +1070,29 @@ class Pipeline(Actor):
         stream.device_window.note(frame.frame_id, frame.swag)
         self._frames_processed += 1
         self.share["frames_processed"] = self._frames_processed
+        # Compiled-call + fusion accounting on the share dict (the
+        # transfer_stats()-style surface the dashboard and bench read).
+        # Totals only -- plain attribute sums, no per-element breakdown
+        # dicts on the per-frame completion path (jit_stats() builds
+        # those on demand).
+        hits = misses = entries = dispatches = 0
+        for node in self.graph.nodes():
+            cache = getattr(node.element, "jit_cache", None)
+            if cache is not None:
+                hits += cache.hits
+                misses += cache.misses
+                entries += cache.entries
+        for segment in self.fused_segments:
+            cache = segment.jit_cache
+            hits += cache.hits
+            misses += cache.misses
+            entries += cache.entries
+            dispatches += segment.calls
+        self.share["jit_cache_hits"] = hits
+        self.share["jit_cache_misses"] = misses
+        self.share["jit_cache_entries"] = entries
+        self.share["fused_segments"] = len(self.fused_segments)
+        self.share["fused_dispatches"] = dispatches
         if not frame.metrics.get("dropped"):
             self._respond(stream, frame, okay=True)
         if stream.state == StreamState.STOP:
@@ -904,11 +1167,13 @@ class Pipeline(Actor):
             return
         outputs = decode_frame_data(dict(frame_data or {}))
         node = self.graph.get_node(frame.paused_pe_name)
-        self._map_out(node, frame.swag, outputs)
+        self._map_out(node, frame, outputs)
         resume_after = frame.paused_pe_name
         frame.paused_pe_name = None
         nodes = self.graph.iterate_after(resume_after, stream.graph_path)
-        self._process_frame_common(stream, frame, nodes=nodes)
+        # RemoteStage parks are partition boundaries too: the suffix
+        # after a remote hop fuses like any full-path walk.
+        self._process_frame_common(stream, frame, nodes=nodes, fuse=True)
 
     # -- frame generators (source elements) --------------------------------
 

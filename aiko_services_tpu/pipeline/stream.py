@@ -54,6 +54,12 @@ class Frame:
     paused_pe_name: str | None = None    # set while parked at a remote stage
     response_topic: str | None = None    # where process_frame_response goes
     created: float = field(default_factory=time.monotonic)
+    # Provenance: bare swag key -> producer element name, for every
+    # value an element of THIS frame wrote.  Fused segments consult it
+    # before donating a buffer -- ingest/user data is never donatable
+    # (the caller may still hold the array, e.g. a device-resident
+    # image ring).
+    produced: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -76,6 +82,16 @@ class Stream:
     # frames ahead of compute (pipeline/overlap.py).
     device_window: DeviceWindow = field(default_factory=DeviceWindow)
     device_inflight: int = DEVICE_INFLIGHT_DEFAULT
+    # Fused device-segment compilation (pipeline/fusion.py): ``fuse``
+    # is the resolved ``auto|off`` mode; ``fusion_plans`` memoizes the
+    # partition of each execution path (keyed by its node-name tuple)
+    # so the fuse decision is made once per stream, not per frame, and
+    # ``fusion_segments`` dedupes the segments themselves across plans
+    # (the full path and post-async resume suffixes share one compiled
+    # segment per member chain).
+    fuse: str = "auto"
+    fusion_plans: dict = field(default_factory=dict)
+    fusion_segments: dict = field(default_factory=dict)
 
     def next_frame_id(self) -> int:
         frame_id = self.frame_count

@@ -83,9 +83,10 @@ class JitCache:
     """Cache ``jax.jit`` computations keyed on input avals.
 
     ``cache(fn)(*args)`` compiles once per distinct (shape, dtype)
-    signature and replays thereafter; ``stats`` exposes hit/miss counts
-    for the Metrics element.  Donation and shardings pass through to
-    ``jax.jit``.
+    signature and replays thereafter; ``stats`` exposes hit/miss/entry
+    counters for the Metrics element, the dashboard share dict
+    (``Pipeline.jit_stats``) and the bench's ``jit_cache_*`` keys.
+    Donation and shardings pass through to ``jax.jit``.
     """
 
     def __init__(self, **jit_kwargs):
@@ -100,6 +101,12 @@ class JitCache:
             (leaf.shape, str(leaf.dtype)) if hasattr(leaf, "shape")
             else repr(leaf) for leaf in leaves)
         return (id(fn), treedef, sig)
+
+    def probe(self, fn, args: tuple, kwargs: dict | None = None) -> bool:
+        """True when a call with these arguments would MISS (trace +
+        compile) -- lets callers time/annotate first-use compiles
+        without racing the counters."""
+        return self._key(fn, args, kwargs or {}) not in self._compiled
 
     def __call__(self, fn: Callable) -> Callable:
         jitted = jax.jit(fn, **self._jit_kwargs)
@@ -117,8 +124,13 @@ class JitCache:
         return wrapper
 
     @property
+    def entries(self) -> int:
+        return len(self._compiled)
+
+    @property
     def stats(self) -> dict:
         return {"hits": self.hits, "misses": self.misses,
+                "entries": len(self._compiled),
                 "signatures": len(self._compiled)}
 
 

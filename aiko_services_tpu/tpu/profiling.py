@@ -73,11 +73,16 @@ class Profiler:
     # -- pipeline annotation hooks -----------------------------------------
 
     def attach(self, pipeline):
-        """Annotate every element run of ``pipeline`` on the trace."""
+        """Annotate every element run -- and every fused-segment
+        dispatch -- of ``pipeline`` on the trace."""
         pipeline.add_hook_handler("pipeline.process_element:0",
                                   self._on_element)
         pipeline.add_hook_handler("pipeline.process_element_post:0",
                                   self._on_element_post)
+        pipeline.add_hook_handler("pipeline.process_segment:0",
+                                  self._on_segment)
+        pipeline.add_hook_handler("pipeline.process_segment_post:0",
+                                  self._on_segment_post)
         self._pipelines.append(pipeline)
 
     def detach(self):
@@ -86,6 +91,10 @@ class Profiler:
                                          self._on_element)
             pipeline.remove_hook_handler("pipeline.process_element_post:0",
                                          self._on_element_post)
+            pipeline.remove_hook_handler("pipeline.process_segment:0",
+                                         self._on_segment)
+            pipeline.remove_hook_handler("pipeline.process_segment_post:0",
+                                         self._on_segment_post)
         self._pipelines.clear()
         self._unwind()
 
@@ -109,6 +118,40 @@ class Profiler:
         annotation = self._open.pop(self._key(variables), None)
         if annotation is not None:
             annotation.__exit__(None, None, None)
+
+    # -- fused-segment spans ------------------------------------------------
+
+    @staticmethod
+    def _segment_keys(variables):
+        base = (variables.get("segment"), variables.get("stream"),
+                variables.get("frame"))
+        return ("segment",) + base, ("compile",) + base
+
+    def _on_segment(self, component, hook, variables):
+        """One span per fused dispatch; a first-use trace additionally
+        opens a ``compile:`` span (keyed by segment name) so first-frame
+        compile time is distinguishable from steady-state step time on
+        the timeline."""
+        seg_key, compile_key = self._segment_keys(variables)
+        for key in (seg_key, compile_key):
+            stale = self._open.pop(key, None)
+            if stale is not None:       # same frame re-entered (retry)
+                stale.__exit__(None, None, None)
+        name = variables.get("segment")
+        if variables.get("compile"):
+            annotation = jax.profiler.TraceAnnotation(f"compile:{name}")
+            annotation.__enter__()
+            self._open[compile_key] = annotation
+        annotation = jax.profiler.TraceAnnotation(f"segment:{name}")
+        annotation.__enter__()
+        self._open[seg_key] = annotation
+
+    def _on_segment_post(self, component, hook, variables):
+        seg_key, compile_key = self._segment_keys(variables)
+        for key in (seg_key, compile_key):   # inner (segment) first
+            annotation = self._open.pop(key, None)
+            if annotation is not None:
+                annotation.__exit__(None, None, None)
 
     def _unwind(self):
         while self._open:

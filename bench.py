@@ -905,6 +905,176 @@ def bench_pipeline_e2e() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 4b. Fused device-segment compilation (ISSUE 2): the same engine over a
+#     3-element synchronous device chain (ImageResize x2 + sync
+#     Detector), ``fuse: auto`` vs ``fuse: off`` side by side.  The gap
+#     is pure dispatch/segmentation overhead -- the cost the fuser
+#     removes -- reported per frame as
+#     ``pipeline_e2e_dispatch_overhead_ms``, with jit-cache and
+#     cold/warm compile-time keys so recompile regressions and the
+#     persistent compile cache's effect are visible across rounds.
+
+FUSION_FRAMES = 24
+FUSION_PASSES = 3
+
+
+def _previous_bench() -> dict:
+    """Latest recorded BENCH_r*.json, for the ``*_vs_baseline`` deltas
+    on keys first recorded by this round's new sections."""
+    import glob
+    records = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")))
+    if not records:
+        return {}
+    try:
+        with open(records[-1]) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def bench_pipeline_fusion() -> dict:
+    import numpy as np
+    from aiko_services_tpu.pipeline import Pipeline
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.transport import reset_broker
+
+    reset_broker()
+    reset_process()
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+
+    def definition(mode):
+        return {
+            "version": 0, "name": f"bench_fusion_{mode}",
+            "runtime": "jax",
+            "graph": ["(R1 (R2 (DET)))"],
+            # disallow: the fused path must stay transfer-clean; the
+            # Detector's slate postprocess rides the engine's counted
+            # finalize fetch.
+            "parameters": {"transfer_guard": "disallow",
+                           "device_inflight": 3, "fuse": mode},
+            "elements": [
+                element("R1", "ImageResize", ["image"], ["image"],
+                        {"width": 512, "height": 512,
+                         "synchronous": True},
+                        module="aiko_services_tpu.elements.image"),
+                element("R2", "ImageResize", ["image"], ["image"],
+                        {"width": 640, "height": 640,
+                         "synchronous": True},
+                        module="aiko_services_tpu.elements.image"),
+                element("DET", "Detector", ["image"],
+                        ["image", "overlay", "detections"],
+                        {"synchronous": True},
+                        module="aiko_services_tpu.elements.detect"),
+            ]}
+
+    rng = np.random.default_rng(0)
+    frames = [rng.integers(0, 255, (576, 576, 3), dtype=np.uint8)
+              for _ in range(4)]
+
+    def run_mode(mode):
+        pipeline = Pipeline(definition(mode), runtime=runtime)
+        responses: "queue.Queue" = queue.Queue()
+        collected: list = []
+
+        def pump(count):
+            for i in range(count):
+                pipeline.process_frame_local(
+                    {"image": frames[i % len(frames)]},
+                    stream_id=f"fusion_{mode}",
+                    queue_response=responses)
+
+        def drain(target):
+            while not responses.empty():
+                *_, metrics, okay, _diag = responses.get()
+                collected.append((metrics, okay))
+            return len(collected) >= target
+
+        timings = {}
+        # Cold/warm per-frame wall time: frame 1 pays the segment trace
+        # + XLA compile (or a persistent-cache hit when
+        # AIKO_COMPILE_CACHE_DIR is set and warm), frame 2 replays.
+        for key in ("cold", "warm"):
+            start = time.perf_counter()
+            pump(1)
+            runtime.run(until=lambda: drain(len(collected) + 1),
+                        timeout=1800.0)
+            timings[key] = (time.perf_counter() - start) * 1000.0
+        if len(collected) < 2 or not all(ok for _, ok in collected):
+            return None, timings, {}, (
+                f"{mode} warmup stalled at {len(collected)}/2")
+
+        best = None
+        for _ in range(FUSION_PASSES):
+            collected.clear()
+            start = time.perf_counter()
+            pump(FUSION_FRAMES)
+            runtime.run(until=lambda: drain(FUSION_FRAMES),
+                        timeout=900.0)
+            elapsed = time.perf_counter() - start
+            if len(collected) < FUSION_FRAMES \
+                    or not all(ok for _, ok in collected):
+                return None, timings, {}, f"{mode} pass incomplete"
+            if best is None or elapsed < best[0]:
+                best = (elapsed, list(collected))
+        share = {key: pipeline.share.get(key) for key in
+                 ("fused_segments", "fused_dispatches",
+                  "jit_cache_hits", "jit_cache_misses",
+                  "jit_cache_entries")}
+        pipeline.stop()
+        return best, timings, share, None
+
+    result: dict = {}
+    fused, fused_timings, fused_share, error = run_mode("auto")
+    if error:
+        runtime.terminate()
+        return {"pipeline_fusion_error": error}
+    off, _off_timings, _off_share, error = run_mode("off")
+    runtime.terminate()
+    if error:
+        return {"pipeline_fusion_error": error}
+
+    def per_frame(rows, key):
+        values = [metrics.get(key, 0) for metrics, _ in rows]
+        return sum(values) / max(1, len(values))
+
+    fused_elapsed, fused_rows = fused
+    off_elapsed, off_rows = off
+    fused_fps = FUSION_FRAMES / fused_elapsed
+    off_fps = FUSION_FRAMES / off_elapsed
+    result.update({
+        "pipeline_e2e_fused_fps": round(fused_fps, 2),
+        "pipeline_e2e_fuse_off_fps": round(off_fps, 2),
+        # The dispatch/segmentation overhead the fuser removes: the
+        # per-frame cost gap between the per-element walk and the
+        # single-dispatch segment walk of the SAME chain.
+        "pipeline_e2e_dispatch_overhead_ms": round(
+            (1.0 / off_fps - 1.0 / fused_fps) * 1000.0, 2),
+        "fused_segments": fused_share.get("fused_segments"),
+        "fused_dispatches_per_frame": round(
+            per_frame(fused_rows, "device_dispatches"), 2),
+        "fuse_off_dispatches_per_frame": round(
+            per_frame(off_rows, "device_dispatches"), 2),
+        "jit_cache_hits": fused_share.get("jit_cache_hits"),
+        "jit_cache_misses": fused_share.get("jit_cache_misses"),
+        "jit_cache_entries": fused_share.get("jit_cache_entries"),
+        "fused_compile_cold_ms": round(fused_timings.get("cold", 0), 1),
+        "fused_compile_warm_ms": round(fused_timings.get("warm", 0), 1),
+    })
+    # Deltas against the previous recorded round, so the next bench
+    # shows whether the dispatch-overhead win and compile times moved.
+    previous = _previous_bench()
+    for key in ("pipeline_e2e_dispatch_overhead_ms",
+                "pipeline_e2e_fused_fps",
+                "fused_compile_cold_ms", "fused_compile_warm_ms"):
+        prior = previous.get(key)
+        if prior:
+            result[f"{key}_vs_baseline"] = round(result[key] / prior, 2)
+    return result
+
+
+# ---------------------------------------------------------------------------
 # 5. ASR real-time factor (BASELINE config 5): seconds of audio
 #    transcribed per wall-clock second, batch of chunks, one dispatch
 #    (mel frontend + encoder + KV-cached 128-token greedy decode all
@@ -1167,6 +1337,7 @@ def main() -> int:
             ("bench_detect", lambda: bench_detect(peak, rtt)),
             ("bench_llm", lambda: bench_llm(peak, rtt)),
             ("bench_pipeline_e2e", bench_pipeline_e2e),
+            ("bench_pipeline_fusion", bench_pipeline_fusion),
             ("bench_asr", lambda: bench_asr(rtt)),
             ("bench_speech_e2e", bench_speech_e2e)):
         try:

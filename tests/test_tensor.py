@@ -68,7 +68,8 @@ def test_jit_cache_hits_and_misses():
     fn(jnp.ones((4,)))
     fn(jnp.ones((4,)))          # same signature -> hit
     fn(jnp.ones((8,)))          # new shape -> miss
-    assert cache.stats == {"hits": 1, "misses": 2, "signatures": 2}
+    assert cache.stats == {"hits": 1, "misses": 2, "entries": 2,
+                           "signatures": 2}
 
 
 def test_jit_cache_bucketed_no_recompile():
