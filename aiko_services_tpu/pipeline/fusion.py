@@ -184,14 +184,26 @@ def partition(pipeline, nodes, stream) -> list:
     a host value a finalize produced earlier in the chain starts a new
     chain -- device traces cannot read host-step products.
 
+    Placed stage heads are partition boundaries (``fusable`` rejects
+    them: the ICI hop + stage admission happen per-node), so segments
+    are always STAGE-LOCAL; each segment records the placed stage it
+    executes inside (``FusedSegment.stage_context`` -- the most recent
+    placed head on the walk), which is what lets the engine run it on
+    that stage's worker thread and attribute its dispatches to the
+    stage.
+
     Segments are memoized per stream by their member-name tuple
     (``stream.fusion_segments``), so the full-path plan and the
     post-async resume suffix plans share one compiled segment instead
     of re-tracing the same chain per plan."""
     entries: list = []
     chain: list[tuple] = []
+    chain_stage: list = [None]      # stage context when the chain began
     host_names: set[str] = set()
     cache = stream.fusion_segments
+    placement = getattr(pipeline, "stage_placement", None)
+    placed = set(placement.plans) if placement is not None else set()
+    stage_context = None
 
     def flush():
         if len(chain) >= 2:
@@ -201,7 +213,8 @@ def partition(pipeline, nodes, stream) -> list:
                 segment = FusedSegment(pipeline,
                                        [n for n, _ in chain],
                                        [d for _, d in chain],
-                                       stream_id=stream.stream_id)
+                                       stream_id=stream.stream_id,
+                                       stage=chain_stage[0])
                 cache[key] = segment
                 pipeline.fused_segments.append(segment)
             entries.append(segment)
@@ -211,6 +224,8 @@ def partition(pipeline, nodes, stream) -> list:
         host_names.clear()
 
     for node in nodes:
+        if node.name in placed:
+            stage_context = node.name
         dfn = fusable(pipeline, node, stream)
         if dfn is None:
             flush()
@@ -220,6 +235,8 @@ def partition(pipeline, nodes, stream) -> list:
         consumed = {mapping.get(name, name) for name in dfn.inputs}
         if consumed & host_names:
             flush()
+        if not chain:
+            chain_stage[0] = stage_context
         chain.append((node, dfn))
         for out in dfn.finalize_outputs:
             host_names.add(out)
@@ -232,13 +249,19 @@ class FusedSegment:
     """A maximal chain of device-pure elements compiled and dispatched
     as ONE XLA computation per frame."""
 
-    def __init__(self, pipeline, nodes, device_fns, stream_id=None):
+    def __init__(self, pipeline, nodes, device_fns, stream_id=None,
+                 stage=None):
         self.nodes = list(nodes)
         self.name = "+".join(node.name for node in nodes)
         # Segments resolve element parameters per stream (shapes,
         # width/height, synchronous) so they are stream-owned; the
         # pipeline registry prunes them when the stream dies.
         self.stream_id = stream_id
+        # The placed stage whose submesh this segment's chain executes
+        # on (None when the chain precedes any placed head): segments
+        # are always stage-local, and a stage-tagged segment may run on
+        # that stage's worker thread under stage-parallel execution.
+        self.stage_context = stage
         self.steps: list[_Step] = []
         self.broken = False           # build/trace failed: run unfused
         self.calls = 0
@@ -402,7 +425,8 @@ class FusedSegment:
     def stats(self) -> dict:
         return {"elements": [node.name for node in self.nodes],
                 "calls": self.calls, "broken": self.broken,
-                "donation": self.donation, "jit": self.jit_cache.stats}
+                "donation": self.donation, "stage": self.stage_context,
+                "jit": self.jit_cache.stats}
 
     def __repr__(self):
         return f"<FusedSegment {self.name}>"

@@ -29,11 +29,20 @@ Two small engine-side mechanisms:
   paces the window by ``block_until_ready``-ing the OLDEST noted frame
   until at most ``device_inflight`` frames (default triple buffering)
   are outstanding -- classic double/triple buffering per stream.
+
+The stage-keyed sibling of the DeviceWindow lives in
+:mod:`~aiko_services_tpu.pipeline.stages`: multi-stage PLACED pipelines
+additionally pace admission per placed stage (``stage_inflight``,
+credit-based backpressure) so frames overlap ACROSS submeshes, while
+this module's window keeps any one stream's dispatch bounded ahead of
+compute.  The two compose: ingest pacing bounds total outstanding
+device work, stage credits bound where in the pipeline it sits.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 from collections import deque
 
 import jax
@@ -81,6 +90,10 @@ class TransferLedger:
         self.policy = policy
         self.implicit = 0
         self.explicit = 0
+        # Counters are bumped from the event loop AND stage-worker
+        # threads (pipeline/stages.py): unsynchronized += would lose
+        # increments.
+        self._count_lock = threading.Lock()
 
     @property
     def active(self) -> bool:
@@ -98,7 +111,8 @@ class TransferLedger:
             yield
 
     def record_implicit(self, count: int = 1):
-        self.implicit += count
+        with self._count_lock:
+            self.implicit += count
 
     @staticmethod
     def is_guard_error(error: BaseException) -> bool:
@@ -114,7 +128,8 @@ class TransferLedger:
         leaves = device_leaves(tree)
         if not leaves:
             return tree
-        self.explicit += 1
+        with self._count_lock:
+            self.explicit += 1
         with jax.transfer_guard_device_to_host("allow"):
             for leaf in leaves:
                 if hasattr(leaf, "copy_to_host_async"):

@@ -34,6 +34,8 @@ from .element import ElementContext, PipelineElement, PipelineElementLoop
 from .fusion import (FUSE_MODES, FusedSegment, partition,
                      setup_compilation_cache)
 from .overlap import DEVICE_INFLIGHT_DEFAULT, TransferLedger
+from .stages import (STAGE_INFLIGHT_DEFAULT, STAGE_PIPELINE_MODES,
+                     StageScheduler)
 from .stream import (Stream, Frame, StreamEvent, StreamState,
                      DEFAULT_STREAM_ID)
 from ..runtime import Lease
@@ -55,6 +57,15 @@ _GRACE_TIME_DEFAULT = 120.0
 # against stages that never complete (see _stream_lease_expired).
 _STALL_REAP_FACTOR = 10
 _METRICS_MEMORY = False           # RSS deltas per element when True
+# Undiscovered remote stages: retry with exponential backoff from the
+# base up to the cap (a fixed 0.25 s forever was a silent hot loop).
+_REMOTE_RETRY_BASE = 0.25
+_REMOTE_RETRY_CAP = 2.0
+
+# Stage-worker threads (pipeline/stages.py) run elements off the event
+# loop; ``get_parameter`` resolution reaches the owning stream through
+# this thread-local instead of the loop's _current_stream_ref.
+_THREAD_STREAM = threading.local()
 
 
 class RemoteStage(PipelineElement):
@@ -112,17 +123,23 @@ class Pipeline(Actor):
         self.fused_segments: list[FusedSegment] = []
         setup_compilation_cache(definition.parameters)
         self.stage_placement = self._build_placement()
+        self.stage_scheduler = self._build_stage_scheduler()
         self.graph = self._build_graph()
         self.share["element_count"] = len(self.graph)
         self.share["streams"] = 0
         self.share["frames_processed"] = 0
         self._frames_processed = 0
+        self._remote_retries = 0
+        self.share["remote_stage_retries"] = 0
 
         self.add_hook("pipeline.process_frame:0")
         self.add_hook("pipeline.process_element:0")
         self.add_hook("pipeline.process_element_post:0")
         self.add_hook("pipeline.process_segment:0")
         self.add_hook("pipeline.process_segment_post:0")
+        self.add_hook("pipeline.process_stage:0")
+        self.add_hook("pipeline.process_stage_post:0")
+        self.add_hook("pipeline.stage_hop:0")
         self.add_hook("pipeline.replacement:0")
 
         self._health_timer = None
@@ -153,7 +170,21 @@ class Pipeline(Actor):
             if "mesh" in block:
                 stages[element_def.name] = dict(block["mesh"])
             elif "devices" in block:
-                stages[element_def.name] = int(block["devices"])
+                want = block["devices"]
+                # ``devices: auto`` splits the pool proportionally to
+                # measured per-stage cost (StagePlacement._resolve);
+                # equal split until profiles exist.
+                if isinstance(want, str) \
+                        and want.strip().lower() == "auto":
+                    stages[element_def.name] = "auto"
+                else:
+                    try:
+                        stages[element_def.name] = int(want)
+                    except (TypeError, ValueError):
+                        raise DefinitionError(
+                            f"element {element_def.name!r}: placement "
+                            f"devices must be a chip count or 'auto', "
+                            f"got {want!r}")
             else:
                 raise DefinitionError(
                     f"element {element_def.name!r}: placement needs "
@@ -164,6 +195,27 @@ class Pipeline(Actor):
         placement = StagePlacement()
         placement.assign(stages)
         return placement
+
+    def _build_stage_scheduler(self):
+        """Stage-parallel execution (pipeline/stages.py): on for
+        multi-stage placed pipelines unless ``stage_pipeline: off``.
+        Single-stage placements have nothing to overlap with, so the
+        per-element path stays exactly as before."""
+        if self.stage_placement is None \
+                or len(self.stage_placement.plans) < 2:
+            return None
+        mode = str(self.definition.parameters.get(
+            "stage_pipeline", "auto")).strip().lower()
+        if mode not in STAGE_PIPELINE_MODES:
+            self.logger.warning("stage_pipeline=%r not one of %s; "
+                                "using auto", mode, STAGE_PIPELINE_MODES)
+            mode = "auto"
+        if mode == "off":
+            return None
+        depth = int(parse_number(
+            self.definition.parameters.get("stage_inflight"),
+            STAGE_INFLIGHT_DEFAULT))
+        return StageScheduler(list(self.stage_placement.plans), depth)
 
     def _cancel_health_timer(self):
         if self._health_timer is not None:
@@ -293,6 +345,12 @@ class Pipeline(Actor):
             self.set_pipeline_parameter(name, value)
 
     def current_stream(self) -> Stream | None:
+        # Stage-worker threads pin their stream thread-locally; the
+        # event loop's reference would be another frame's stream (or
+        # None) while a worker is mid-element.
+        stream = getattr(_THREAD_STREAM, "stream", None)
+        if stream is not None:
+            return stream
         return self._current_stream_ref
 
     def transfer_stats(self) -> dict:
@@ -332,6 +390,23 @@ class Pipeline(Actor):
         totals["elements"] = elements
         totals["segments"] = segments
         return totals
+
+    def stage_stats(self) -> dict:
+        """Stage-parallel accounting: per-stage admission window state,
+        occupancy over the scheduler's window, placed chip counts and
+        the measured cost profile (the bench's ``stage_occupancy_*``
+        keys read the occupancy values)."""
+        if self.stage_scheduler is None:
+            return {}
+        stats = self.stage_scheduler.stats
+        if self.stage_placement is not None:
+            for name, plan in self.stage_placement.plans.items():
+                entry = stats.setdefault(name, {})
+                entry["devices"] = int(plan.mesh.devices.size)
+                cost = self.stage_placement.costs.get(name)
+                if cost:
+                    entry["cost_ms"] = round(cost * 1000.0, 3)
+        return stats
 
     def fusion_stats(self) -> dict:
         """Fused-segment accounting: segment/dispatch totals the bench
@@ -481,6 +556,20 @@ class Pipeline(Actor):
         if stream.lease is not None:
             stream.lease.terminate()
         stream.device_window.clear()    # drop refs without blocking
+        # Stage credits held by this stream's in-flight frames go back
+        # to the window (and wake other streams' queued frames); queued
+        # tokens for dead frames are skipped lazily when popped.
+        for frame in list(stream.frames.values()):
+            self._release_stage(stream, frame)
+        # Completed frames' responses still buffered behind an
+        # in-flight predecessor: deliver them (best-effort seq order)
+        # rather than dropping finished work -- pre-reorder-buffer
+        # behavior responded at completion, and callers count replies.
+        for seq in sorted(stream.delivery_pending):
+            item = stream.delivery_pending.pop(seq)
+            if item is not None:
+                done_frame, okay, diagnostic = item
+                self._respond(stream, done_frame, okay, diagnostic)
         # Fused segments are stream-owned (their captures/parameters
         # resolved against this stream): release them with it, or the
         # registry pins stale compiled calls (and captured weights)
@@ -532,6 +621,7 @@ class Pipeline(Actor):
             stream.queue_response = queue_response
         frame = Frame(frame_id=stream.next_frame_id(),
                       swag=dict(frame_data))
+        self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
         # Bounded dispatch window: before this frame's device work
         # enqueues, sync the oldest completed-but-unsynced frame(s) so
@@ -551,16 +641,37 @@ class Pipeline(Actor):
             frame_id = stream.next_frame_id()
         frame = Frame(frame_id=int(frame_id), swag=dict(frame_data))
         frame.response_topic = stream_dict.get("response_topic")
+        stale = stream.frames.get(frame.frame_id)
+        if stale is not None:
+            # A wire caller re-ingested a live frame id: the replaced
+            # frame's delivery slot (and stage credit) must not wedge
+            # the stream's reorder buffer / admission window.
+            self._release_stage(stream, stale)
+            self._deliver(stream, stale, okay=False, skip=True)
+        self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
         stream.device_window.pace(stream.device_inflight)
         self._process_frame_common(stream, frame)
+
+    def _assign_delivery_seq(self, stream: Stream, frame: Frame) -> None:
+        """Under stage-parallel execution frames complete out of walk
+        order; responses are re-ordered to ingest order (_deliver)."""
+        if self.stage_scheduler is not None:
+            frame.delivery_seq = stream.delivery_count
+            stream.delivery_count += 1
 
     # -- the hot loop ------------------------------------------------------
 
     def _process_frame_common(self, stream: Stream, frame: Frame,
                               nodes=None, fuse=False):
         if stream.state not in (StreamState.START, StreamState.RUN):
+            # The stream died while this frame was parked/queued: give
+            # its stage credit back (the scheduler window is
+            # pipeline-global -- leaking here would wedge EVERY stream
+            # at that stage) and consume its delivery slot.
             stream.frames.pop(frame.frame_id, None)
+            self._release_stage(stream, frame)
+            self._deliver(stream, frame, okay=False, skip=True)
             return
         stream.last_frame_time = time.monotonic()   # grace lease clock
         self.run_hook("pipeline.process_frame:0",
@@ -592,6 +703,21 @@ class Pipeline(Actor):
                         # segment without re-failing.
                         entries[index:index + 1] = entry.nodes
                         continue
+                    if self.stage_scheduler is not None \
+                            and entry.stage_context is not None:
+                        # Stage-local segment under stage-parallel
+                        # execution: ONE dispatch on the stage's worker
+                        # thread; the frame parks and the loop is free
+                        # to walk other frames' stages meanwhile.
+                        # ALWAYS via the worker (even when the frame no
+                        # longer holds the stage credit, e.g. resumed
+                        # past an in-stage async park): the single
+                        # worker is what serializes the segment's
+                        # unsynchronized JitCache across frames.
+                        # Returns None (frame errored at resolve) or
+                        # True (parked); either way this walk is done.
+                        self._submit_stage_segment(stream, frame, entry)
+                        return
                     outcome = self._run_fused_segment(stream, frame,
                                                       entry)
                     if outcome is None:
@@ -602,18 +728,60 @@ class Pipeline(Actor):
                     index += 1
                     continue
                 node = entry
+                if self.stage_scheduler is not None \
+                        and frame.stage != node.name \
+                        and node.name in self.stage_placement.plans:
+                    # Placed stage boundary: admission (credit window)
+                    # and the rest of the walk happen on a fresh
+                    # mailbox turn, so frame k+1's upstream stage work
+                    # interleaves with frame k's downstream stage.
+                    # ``stage_waiting`` marks the one in-flight
+                    # admission post and the post carries the Frame
+                    # object; enter_stage_frame discards any post that
+                    # doesn't match both (duplicates, stale posts and
+                    # queued tokens from a destroyed same-id stream).
+                    frame.stage_waiting = node.name
+                    self.post_self("enter_stage_frame",
+                                   [stream.stream_id, frame.frame_id,
+                                    node.name, False, frame])
+                    return
                 element = node.element
                 if isinstance(element, RemoteStage):
+                    # Leaving placed-stage-land: a frame parked at (or
+                    # retrying discovery of) a remote stage must not
+                    # pin its last placed stage's admission credit for
+                    # the whole round trip -- a slow remote would wedge
+                    # the window for every stream.
+                    self._release_stage(stream, frame)
                     if self._forward_frame(stream, frame, node):
+                        frame.remote_retries = 0
                         return            # frame parked at remote stage
-                    # Remote undiscovered yet: retry shortly FROM THIS
-                    # NODE -- elements before it already ran and must not
-                    # run again (their effects are in the swag).  The
-                    # frame STAYS in stream.frames so graceful
-                    # destroy_stream counts it as in-flight.
+                    # Remote undiscovered yet: retry FROM THIS NODE --
+                    # elements before it already ran and must not run
+                    # again (their effects are in the swag).  The frame
+                    # STAYS in stream.frames so graceful destroy_stream
+                    # counts it as in-flight.  Exponential backoff with
+                    # a cap (a fixed short retry forever is a silent
+                    # hot loop) and a counted share metric so a missing
+                    # remote stage is VISIBLE.
+                    delay = min(
+                        _REMOTE_RETRY_BASE * (2 ** frame.remote_retries),
+                        _REMOTE_RETRY_CAP)
+                    frame.remote_retries += 1
+                    frame.metrics["remote_retries"] = frame.remote_retries
+                    self._remote_retries += 1
+                    self.share["remote_stage_retries"] = \
+                        self._remote_retries
+                    if frame.remote_retries in (4, 8) \
+                            or frame.remote_retries % 16 == 0:
+                        self.logger.warning(
+                            "stream %s frame %s: remote stage %s still "
+                            "undiscovered after %d retries (next in "
+                            "%.2f s)", stream.stream_id, frame.frame_id,
+                            node.name, frame.remote_retries, delay)
                     self.post_self("retry_frame_at",
                                    [stream.stream_id, frame, node.name],
-                                   delay=0.25)
+                                   delay=delay)
                     return
                 inputs, missing, host_typed = self._map_in(node, swag)
                 if missing:
@@ -624,13 +792,24 @@ class Pipeline(Actor):
                 if self.stage_placement is not None \
                         and node.name in self.stage_placement.plans:
                     # Stage hop: reshard this stage's inputs onto its
-                    # submesh (device-to-device over ICI; a no-op when
-                    # already resident there).  Host-typed inputs stay
-                    # host-side -- re-uploading what _map_in just
-                    # fetched would undo the contract.
+                    # submesh (device-to-device over ICI; skipped per
+                    # leaf when already resident there).  device_put is
+                    # async -- the copy overlaps the upstream stage's
+                    # next-frame compute; only the dispatch cost lands
+                    # on the loop.  Host-typed inputs stay host-side --
+                    # re-uploading what _map_in just fetched would undo
+                    # the contract.
+                    hop_start = time.perf_counter()
                     inputs.update(self.stage_placement.transfer(
                         {name: value for name, value in inputs.items()
                          if name not in host_typed}, node.name))
+                    hop_ms = (time.perf_counter() - hop_start) * 1000.0
+                    frame.metrics[f"{node.name}_hop_ms"] = hop_ms
+                    self.run_hook("pipeline.stage_hop:0",
+                                  lambda: {"stage": node.name,
+                                           "stream": stream.stream_id,
+                                           "frame": frame.frame_id,
+                                           "ms": hop_ms})
                 self.run_hook("pipeline.process_element:0",
                               lambda: {"element": node.name,
                                        "stream": stream.stream_id,
@@ -638,6 +817,15 @@ class Pipeline(Actor):
                 if element.frame_is_async(stream):
                     self._submit_frame_async(stream, frame, node, inputs)
                     return        # frame parked at local async stage
+                if self.stage_scheduler is not None \
+                        and frame.stage == node.name:
+                    # Synchronous placed-stage head under stage-parallel
+                    # execution: run it on the stage's worker thread so
+                    # the event loop keeps walking other frames while
+                    # this stage's chips work -- cross-stage pipelining
+                    # of plain synchronous elements.
+                    self._submit_stage_frame(stream, frame, node, inputs)
+                    return        # frame parked on the stage worker
                 start = time.perf_counter()
                 # Absolute start stamp: with overlapped frames, element
                 # spans interleave across frames -- durations alone
@@ -750,19 +938,20 @@ class Pipeline(Actor):
                     ", ".join(s.name for s in fused))
         return plan
 
-    def _run_fused_segment(self, stream: Stream, frame: Frame,
-                           segment: FusedSegment):
-        """Execute a whole segment as ONE device dispatch.  Returns True
-        on success, None when the frame was errored, False to fall back
-        to per-element execution (first-call build/trace failure -- the
-        segment is poisoned so later frames skip it outright)."""
-        swag = frame.swag
-        resolved, missing = segment.resolve(swag)
+    def _segment_begin(self, stream: Stream, frame: Frame,
+                       segment: FusedSegment):
+        """Shared dispatch preamble for the inline and stage-worker
+        segment paths: resolve inputs, pick donations, probe the
+        compile, stamp spans, fire the enter hook.  Returns
+        (resolved, donated, compiling, start), or None when the frame
+        was errored on missing inputs."""
+        resolved, missing = segment.resolve(frame.swag)
         if missing:
             self._frame_error(stream, frame,
                               f"{segment.name}: missing inputs {missing}")
             return None
-        donated = segment.donate_keys(resolved, swag, frame.produced)
+        donated = segment.donate_keys(resolved, frame.swag,
+                                      frame.produced)
         compiling = segment.would_compile(resolved, donated)
         start = time.perf_counter()
         for node in segment.nodes:
@@ -773,6 +962,18 @@ class Pipeline(Actor):
                                "stream": stream.stream_id,
                                "frame": frame.frame_id,
                                "compile": compiling})
+        return resolved, donated, compiling, start
+
+    def _run_fused_segment(self, stream: Stream, frame: Frame,
+                           segment: FusedSegment):
+        """Execute a whole segment as ONE device dispatch.  Returns True
+        on success, None when the frame was errored, False to fall back
+        to per-element execution (first-call build/trace failure -- the
+        segment is poisoned so later frames skip it outright)."""
+        begun = self._segment_begin(stream, frame, segment)
+        if begun is None:
+            return None
+        resolved, donated, compiling, start = begun
         ledger = self.transfer_ledger
 
         def post_hook(event):
@@ -810,6 +1011,18 @@ class Pipeline(Actor):
             self.logger.exception("segment %s raised", segment.name)
             self._frame_error(stream, frame, f"{segment.name}: {error}")
             return None
+        return self._segment_finish(stream, frame, segment, out,
+                                    resolved, donated, post_hook,
+                                    time.perf_counter() - start)
+
+    def _segment_finish(self, stream: Stream, frame: Frame,
+                        segment: FusedSegment, out: dict, resolved: dict,
+                        donated: set, post_hook, elapsed: float):
+        """Map a completed segment dispatch out into the swag (shared by
+        the inline path and the stage-worker continuation).  Returns
+        True, or None when the frame was errored."""
+        swag = frame.swag
+        ledger = self.transfer_ledger
         # Donated buffers are dead: drop the stale qualified aliases
         # before map-out rewrites the bare keys, so nothing in the swag
         # can reach an invalidated buffer (DeviceWindow syncs swag
@@ -839,7 +1052,6 @@ class Pipeline(Actor):
                                   segment.name)
             self._frame_error(stream, frame, f"{segment.name}: {error}")
             return None
-        elapsed = time.perf_counter() - start
         # The single dispatch's wall time lands on the tail element (so
         # per-element p50 keys stay populated); the members carry 0.0.
         frame.metrics[f"{segment.nodes[-1].name}_time"] = elapsed
@@ -851,6 +1063,280 @@ class Pipeline(Actor):
             frame.metrics.get("device_dispatches", 0) + 1
         post_hook(StreamEvent.OKAY)
         return True
+
+    # -- stage-parallel execution (pipeline/stages.py) ---------------------
+
+    def enter_stage_frame(self, stream_id, frame_id, node_name,
+                          from_queue=False, frame_ref=None):
+        """Continuation: admit a frame into a placed stage's credit
+        window and resume its walk at the stage head.  When the window
+        is full the frame queues FIFO (still holding its PREVIOUS
+        stage's credit, so backpressure propagates upstream) and is
+        re-posted by the releasing frame; a popped waiter whose credit
+        was stolen by an interleaving admission requeues at the FRONT,
+        preserving queue (and per-stream frame) order."""
+        stream = self.streams.get(str(stream_id))
+        frame = stream.frames.get(int(frame_id)) \
+            if stream is not None else None
+        if frame is None or frame.paused_pe_name is not None \
+                or frame.stage_waiting != node_name \
+                or (frame_ref is not None and frame is not frame_ref):
+            # Dead/stale/duplicate post: the frame vanished while
+            # queued, was already admitted by an earlier post, or a
+            # destroyed stream's post/token matched a RECREATED
+            # stream's same-id frame (the Frame identity check catches
+            # that even when the new frame waits for the same stage).
+            # Acting on it would re-run elements or admit a frame out
+            # of order; hand the slot (and any reservation the popped
+            # token carried) to the next waiter so the queue never
+            # starves.
+            if from_queue and self.stage_scheduler is not None:
+                self.stage_scheduler.cancel_reservation(node_name)
+            self._pump_stage(node_name)
+            return
+        scheduler = self.stage_scheduler
+        if scheduler is not None and frame.stage != node_name:
+            if not scheduler.try_admit(node_name,
+                                       reserved=bool(from_queue)):
+                scheduler.enqueue(node_name,
+                                  [str(stream_id), int(frame_id),
+                                   node_name, True, frame],
+                                  front=bool(from_queue))
+                return
+            frame.stage_waiting = None
+            self._release_stage(stream, frame)
+            frame.stage = node_name
+            frame.stage_generation = \
+                self.stage_placement.generation \
+                if self.stage_placement is not None else 0
+            frame.metrics[f"stage_{node_name}_admit"] = \
+                time.perf_counter()
+            # Which placement generation this admission ran under --
+            # the replace() test (and post-mortems) read it to prove a
+            # frame re-entered on fresh submeshes, not a stale mesh.
+            frame.metrics[f"stage_{node_name}_generation"] = \
+                frame.stage_generation
+            self.run_hook("pipeline.process_stage:0",
+                          lambda: {"stage": node_name,
+                                   "stream": stream.stream_id,
+                                   "frame": frame.frame_id,
+                                   "generation": frame.stage_generation})
+        if not self._resume_walk_at(stream, frame, node_name, fuse=True):
+            self._frame_error(
+                stream, frame,
+                f"enter_stage_frame: unknown node {node_name}")
+
+    def _resume_walk_at(self, stream: Stream, frame: Frame,
+                        node_name: str, fuse: bool) -> bool:
+        """Resume a frame's walk at ``node_name`` on its stream path
+        (stage admission, segment fallback, remote retry all land
+        here).  Returns False when the node is not on the path -- the
+        caller decides whether that errors the frame."""
+        path = self._stream_path(stream)
+        for index, node in enumerate(path):
+            if node.name == node_name:
+                self._process_frame_common(stream, frame,
+                                           nodes=path[index:], fuse=fuse)
+                return True
+        return False
+
+    def _release_stage(self, stream: Stream, frame: Frame) -> None:
+        """Return the frame's stage credit (next-stage admission, async
+        park, completion, error, stream teardown) and wake the next
+        queued frame."""
+        stage, frame.stage = frame.stage, None
+        # A released frame is no longer waiting anywhere: its queued
+        # token (if any) must read as stale when popped.
+        frame.stage_waiting = None
+        if stage is None or self.stage_scheduler is None:
+            return
+        admit = frame.metrics.get(f"stage_{stage}_admit")
+        if admit is not None:
+            frame.metrics[f"stage_{stage}_ms"] = \
+                (time.perf_counter() - admit) * 1000.0
+        self.run_hook("pipeline.process_stage_post:0",
+                      lambda: {"stage": stage,
+                               "stream": stream.stream_id,
+                               "frame": frame.frame_id})
+        waiter = self.stage_scheduler.release(stage)
+        if waiter is not None:
+            self.post_self("enter_stage_frame", list(waiter))
+
+    def _pump_stage(self, stage: str) -> None:
+        scheduler = self.stage_scheduler
+        if scheduler is None:
+            return
+        waiter = scheduler.next_waiter(stage)
+        if waiter is not None:
+            self.post_self("enter_stage_frame", list(waiter))
+
+    def _submit_stage_frame(self, stream: Stream, frame: Frame, node,
+                            inputs: dict) -> None:
+        """Run a synchronous placed-stage head element on the stage's
+        worker thread: the frame parks exactly like an async stage and
+        resumes through the mailbox, so while this stage's chips work
+        on frame k the event loop walks frame k+1 into the upstream
+        stage.  The single worker per stage keeps per-stream order."""
+        element = node.element
+        frame.paused_pe_name = node.name
+        stream_id, frame_id = stream.stream_id, frame.frame_id
+        node_name = node.name
+        submitted = time.perf_counter()
+        frame.metrics[f"{node_name}_time_start"] = submitted
+        if element.device_resident:
+            frame.metrics["device_dispatches"] = \
+                frame.metrics.get("device_dispatches", 0) + 1
+        ledger = self.transfer_ledger
+
+        def job():
+            start = time.perf_counter()
+            _THREAD_STREAM.stream = stream
+            try:
+                if element.device_resident and ledger.active:
+                    with ledger.guard():
+                        result = element.process_frame(stream, **inputs)
+                else:
+                    result = element.process_frame(stream, **inputs)
+                event, outputs = result if isinstance(result, tuple) \
+                    else (result, {})
+                outputs = outputs or {}
+            except Exception as error:
+                if ledger.is_guard_error(error):
+                    ledger.record_implicit()
+                self.logger.exception(
+                    "element %s raised (stage worker)", node_name)
+                event, outputs = StreamEvent.ERROR, \
+                    {"diagnostic": str(error)}
+            finally:
+                _THREAD_STREAM.stream = None
+            self.post_self("resume_stage_frame",
+                           [stream_id, frame_id, node_name, event,
+                            outputs, start,
+                            time.perf_counter() - start, submitted,
+                            frame])
+
+        self.stage_scheduler.executor(node_name).submit(job)
+
+    def resume_stage_frame(self, stream_id, frame_id, node_name, event,
+                           outputs, exec_start, elapsed, submitted,
+                           frame_ref):
+        """Continuation: a stage worker finished a synchronous placed
+        element.  The post carries the Frame OBJECT it executed for: a
+        stale post from a destroyed stream must never resume a
+        recreated same-id stream's same-id frame (ids restart at 0).
+        Re-stamps the span to the ACTUAL execution window (overlap
+        assertions read ``*_time_start``) and records the queue window
+        -- the time the frame's hop rode along behind the previous
+        frame's stage compute."""
+        stream = self.streams.get(str(stream_id))
+        if stream is None:
+            return
+        frame = stream.frames.get(int(frame_id))
+        if frame is not frame_ref:
+            return              # stale post from a prior incarnation
+        if frame is not None and frame.paused_pe_name == node_name:
+            frame.metrics[f"{node_name}_time_start"] = exec_start
+            frame.metrics[f"{node_name}_queue_ms"] = \
+                (exec_start - submitted) * 1000.0
+        self.resume_frame_local(stream_id, frame_id, node_name, event,
+                                outputs, elapsed, frame_ref)
+
+    def _submit_stage_segment(self, stream: Stream, frame: Frame,
+                              segment: FusedSegment):
+        """Dispatch a stage-local fused segment on its stage's worker
+        thread.  Returns True (parked), or None (frame errored at
+        resolve)."""
+        begun = self._segment_begin(stream, frame, segment)
+        if begun is None:
+            return None
+        resolved, donated, _compiling, _submitted = begun
+        frame.paused_pe_name = segment.name
+        stream_id, frame_id = stream.stream_id, frame.frame_id
+        ledger = self.transfer_ledger
+
+        def job():
+            start = time.perf_counter()
+            _THREAD_STREAM.stream = stream
+            out, diagnostic = None, ""
+            # Re-probe on the worker, where this segment's dispatches
+            # are serialized: the loop-side probe goes stale when an
+            # earlier frame's job is still compiling this signature
+            # (window depth >= 2), and a stale True would let a
+            # transient data error permanently poison the segment.
+            compile_now = segment.would_compile(resolved, donated)
+            try:
+                if ledger.active:
+                    with ledger.guard():
+                        out = segment.call(resolved, donated)
+                else:
+                    out = segment.call(resolved, donated)
+            except Exception as error:
+                if ledger.is_guard_error(error):
+                    ledger.record_implicit()
+                self.logger.exception(
+                    "segment %s raised (stage worker)", segment.name)
+                diagnostic = str(error)
+            finally:
+                _THREAD_STREAM.stream = None
+            self.post_self("resume_stage_segment",
+                           [stream_id, frame_id, segment, out,
+                            diagnostic, resolved, donated, compile_now,
+                            start, time.perf_counter() - start, frame])
+
+        self.stage_scheduler.executor(segment.stage_context).submit(job)
+        return True
+
+    def resume_stage_segment(self, stream_id, frame_id, segment, out,
+                             diagnostic, resolved, donated, compiling,
+                             exec_start, elapsed, frame_ref):
+        """Continuation: a stage worker finished (or failed) a fused
+        segment dispatch; map out and keep walking after the segment.
+        Frame identity is validated (like resume_stage_frame) so stale
+        posts from a destroyed same-id stream are discarded."""
+        stream = self.streams.get(str(stream_id))
+        frame = stream.frames.get(int(frame_id)) \
+            if stream is not None else None
+        if frame is None or frame is not frame_ref \
+                or frame.paused_pe_name != segment.name:
+            return
+        frame.paused_pe_name = None
+        for node in segment.nodes:
+            frame.metrics[f"{node.name}_time_start"] = exec_start
+
+        def post_hook(event):
+            self.run_hook("pipeline.process_segment_post:0",
+                          lambda: {"segment": segment.name,
+                                   "stream": stream.stream_id,
+                                   "frame": frame.frame_id,
+                                   "event": event,
+                                   "compile": compiling,
+                                   "time":
+                                   time.perf_counter() - exec_start})
+
+        if out is None:
+            post_hook(StreamEvent.ERROR)
+            if compiling:
+                # First-signature trace/compile failure: poison the
+                # segment and replay per-element -- the cached plan
+                # splices broken segments on the next walk.
+                segment.broken = True
+                self.logger.error(
+                    "segment %s: stage-worker trace/compile failed; "
+                    "falling back to per-element execution",
+                    segment.name)
+                if self._resume_walk_at(stream, frame,
+                                        segment.nodes[0].name,
+                                        fuse=True):
+                    return
+            self._frame_error(stream, frame,
+                              f"{segment.name}: {diagnostic}")
+            return
+        if self._segment_finish(stream, frame, segment, out, resolved,
+                                donated, post_hook, elapsed) is None:
+            return
+        nodes = self.graph.iterate_after(segment.nodes[-1].name,
+                                         stream.graph_path)
+        self._process_frame_common(stream, frame, nodes=nodes, fuse=True)
 
     # -- local async stage park / submit / resume --------------------------
 
@@ -884,7 +1370,7 @@ class Pipeline(Actor):
             self.post_self("resume_frame_local",
                            [stream_id, frame_id, node_name, event,
                             outputs or {},
-                            time.perf_counter() - start])
+                            time.perf_counter() - start, frame])
 
         ledger = self.transfer_ledger
         try:
@@ -897,6 +1383,14 @@ class Pipeline(Actor):
             else:
                 node.element.process_frame_start(stream, complete,
                                                  **inputs)
+            if frame.stage is not None:
+                # Async elements own their admission discipline
+                # (MicroBatcher max_batch, batcher slots) -- whether
+                # the park is the stage head itself or an unplaced
+                # async element deeper in the stage: holding the credit
+                # through the park would cap cross-frame batching at
+                # the window depth.
+                self._release_stage(stream, frame)
         except Exception as error:
             if ledger.is_guard_error(error):
                 ledger.record_implicit()
@@ -908,15 +1402,21 @@ class Pipeline(Actor):
             self._frame_error(stream, frame, f"{node_name}: {error}")
 
     def resume_frame_local(self, stream_id, frame_id, node_name,
-                           event, outputs, elapsed):
+                           event, outputs, elapsed, frame_ref=None):
         """Continuation: a parked async LOCAL stage completed (the local
-        analogue of ``process_frame_response``)."""
+        analogue of ``process_frame_response``).  ``frame_ref`` (when
+        the poster holds the Frame object) guards against a stale
+        completion resuming a REPLACEMENT frame parked at the same
+        (stream_id, frame_id, node) -- e.g. after a wire re-ingest of a
+        live frame id."""
         stream = self.streams.get(str(stream_id))
         if stream is None:
             return                      # stream destroyed while parked
         frame = stream.frames.get(int(frame_id))
         if frame is None or frame.paused_pe_name != node_name:
             return
+        if frame_ref is not None and frame is not frame_ref:
+            return                      # stale post: frame was replaced
         frame.paused_pe_name = None
         frame.metrics[f"{node_name}_time"] = elapsed
         self.run_hook("pipeline.process_element_post:0",
@@ -952,11 +1452,28 @@ class Pipeline(Actor):
             if event == StreamEvent.ERROR else f"bad event {event!r}"
         self._frame_error(stream, frame, f"{node_name}: {diagnostic}")
 
+    def _readmit_frame(self, stream: Stream, frame: Frame) -> bool:
+        """Re-register a retried/replayed frame with the stream.  A
+        DIFFERENT live frame under the same id means this retry is
+        stale (the stream was destroyed and recreated while the
+        delayed post was pending) -- acting on it would corrupt the new
+        incarnation.  A frame the stream no longer tracks re-enters
+        with a FRESH delivery sequence: its old slot belongs to a dead
+        incarnation's reorder buffer."""
+        existing = stream.frames.get(frame.frame_id)
+        if existing is not None:
+            return existing is frame
+        frame.delivery_seq = None
+        self._assign_delivery_seq(stream, frame)
+        stream.frames[frame.frame_id] = frame
+        return True
+
     def retry_frame(self, stream_id, frame: Frame):
         stream = self.streams.get(str(stream_id))
         if stream is None:
             return
-        stream.frames[frame.frame_id] = frame
+        if not self._readmit_frame(stream, frame):
+            return
         # Replays run per-element (explicit node list): a prior attempt
         # may have fused -- and donated -- its way through this swag, so
         # the retry must not assume segment inputs still exist as the
@@ -970,15 +1487,14 @@ class Pipeline(Actor):
         stream = self.streams.get(str(stream_id))
         if stream is None:
             return
-        stream.frames[frame.frame_id] = frame
-        path = self._stream_path(stream)
-        for index, node in enumerate(path):
-            if node.name == node_name:
-                self._process_frame_common(stream, frame,
-                                           nodes=path[index:])
-                return
-        self._frame_error(stream, frame,
-                          f"retry_frame_at: unknown node {node_name}")
+        if not self._readmit_frame(stream, frame):
+            return
+        # fuse=False: replays walk per-element (see retry_frame).
+        if not self._resume_walk_at(stream, frame, node_name,
+                                    fuse=False):
+            self._frame_error(
+                stream, frame,
+                f"retry_frame_at: unknown node {node_name}")
 
     # -- name mapping ------------------------------------------------------
 
@@ -1064,6 +1580,8 @@ class Pipeline(Actor):
             time.perf_counter() - frame.metrics["time_pipeline_start"])
         stream.last_frame_time = time.monotonic()   # grace lease clock
         stream.frames.pop(frame.frame_id, None)
+        self._release_stage(stream, frame)
+        self._record_stage_costs(frame)
         # The frame COMPLETES without a host sync: its device leaves may
         # still be computing (async dispatch).  Note them so ingest
         # pacing bounds how far dispatch runs ahead of compute.
@@ -1093,17 +1611,71 @@ class Pipeline(Actor):
         self.share["jit_cache_entries"] = entries
         self.share["fused_segments"] = len(self.fused_segments)
         self.share["fused_dispatches"] = dispatches
-        if not frame.metrics.get("dropped"):
-            self._respond(stream, frame, okay=True)
+        self._deliver(stream, frame, okay=True,
+                      skip=bool(frame.metrics.get("dropped")))
         if stream.state == StreamState.STOP:
             self.post_self("destroy_stream", [stream.stream_id, True])
+
+    def _record_stage_costs(self, frame: Frame) -> None:
+        """Feed the placement's cost profile from the frame's measured
+        stage-head element spans, so ``devices: auto`` splits track the
+        workload (and re-balance at the next replace())."""
+        placement = self.stage_placement
+        if placement is None:
+            return
+        for stage in placement.plans:
+            if stage not in self.graph:
+                continue
+            if self.graph.get_node(stage).element.is_async:
+                # An async head's span is completion-minus-submit --
+                # batch/queue wait included, which GROWS under load and
+                # would steer the auto split toward the waiting stage.
+                continue
+            elapsed = frame.metrics.get(f"{stage}_time")
+            if elapsed:
+                placement.record_cost(stage, float(elapsed))
+
+    def _deliver(self, stream: Stream, frame: Frame, okay: bool,
+                 diagnostic: str = "", skip: bool = False) -> None:
+        """In-order per-stream delivery: under stage-parallel execution
+        frames complete out of ingest order (per-stage workers, async
+        stages), so responses buffer until every predecessor responded.
+        ``skip`` consumes the sequence slot without responding (dropped
+        frames)."""
+        seq = frame.delivery_seq
+        if seq is None:
+            if not skip:
+                self._respond(stream, frame, okay, diagnostic)
+            return
+        stream.delivery_pending[seq] = \
+            None if skip else (frame, okay, diagnostic)
+        self._flush_delivery(stream)
+
+    def _flush_delivery(self, stream: Stream) -> None:
+        while stream.delivery_next in stream.delivery_pending:
+            item = stream.delivery_pending.pop(stream.delivery_next)
+            stream.delivery_next += 1
+            if item is not None:
+                pending_frame, okay, diagnostic = item
+                self._respond(stream, pending_frame, okay, diagnostic)
 
     def _frame_error(self, stream: Stream, frame: Frame, diagnostic: str):
         self.logger.error("stream %s frame %s: %s",
                           stream.stream_id, frame.frame_id, diagnostic)
         stream.frames.pop(frame.frame_id, None)
+        self._release_stage(stream, frame)
         stream.state = StreamState.ERROR
-        self._respond(stream, frame, okay=False, diagnostic=diagnostic)
+        if frame.delivery_seq is not None:
+            # Deliver the error IN its slot so already-completed
+            # successors' buffered okay-responses flush behind it
+            # instead of being dropped; whatever stays gapped (a
+            # predecessor still in flight) drains at destroy.
+            stream.delivery_pending[frame.delivery_seq] = \
+                (frame, False, diagnostic)
+            self._flush_delivery(stream)
+        else:
+            self._respond(stream, frame, okay=False,
+                          diagnostic=diagnostic)
         self.post_self("destroy_stream", [stream.stream_id])
 
     def _respond(self, stream: Stream, frame: Frame, okay: bool,
@@ -1234,6 +1806,8 @@ class Pipeline(Actor):
         self._cancel_health_timer()
         for stream_id in list(self.streams):
             self._destroy_stream_now(stream_id)
+        if self.stage_scheduler is not None:
+            self.stage_scheduler.stop()
         super().stop()
 
 

@@ -1,0 +1,249 @@
+"""Stage-parallel execution over placed submeshes (ISSUE 3 tentpole).
+
+PR 1 overlapped frames within a stream and PR 2 fused device chains into
+single dispatches, but a multi-stage *placed* pipeline (``placement:``
+blocks -> :class:`~.tensor.StagePlacement` submeshes) still walked every
+frame stage-by-stage on one event-loop turn: while frame k occupied the
+LLM stage's chips, the detect stage's chips idled.  Profiled model
+segmentation across multi-TPU systems (arXiv:2503.01025) and
+topology-aware auto-parallel placement (AoiZora, arXiv:2606.17566) both
+identify stage balance + inter-stage hop locality as where the remaining
+end-to-end throughput lives.  This module makes placed stages execute
+like a hardware pipeline:
+
+- :class:`StageScheduler` keeps a **credit-based admission window per
+  placed stage** (the stage-keyed analogue of PR 1's per-stream
+  ``DeviceWindow``; ``stage_inflight`` pipeline parameter, default
+  depth 2).  A frame admits into a stage before running its head
+  element, holds the credit until the NEXT stage admits it (so a full
+  downstream window backpressures upstream admissions, exactly like
+  pipeline stall propagation in hardware), and frames denied admission
+  queue FIFO and resume when a credit frees.  Admission happens on a
+  fresh mailbox turn, so frame k+1's upstream stage work interleaves
+  with frame k's downstream stage on the same event loop.
+- :class:`StageExecutor` gives each placed stage **one FIFO worker
+  thread**: synchronous stage-head elements (and stage-local fused
+  segments) execute there instead of on the event loop, parking the
+  frame like an async element and resuming through the mailbox.  While
+  frame k blocks on the LLM submesh's result, the event loop is free to
+  walk frame k+1 onto the detect submesh -- cross-stage pipelining of
+  plain synchronous elements, with per-stream order preserved by the
+  FIFO queue.  Async elements keep their own admission discipline
+  (MicroBatcher/ContinuousBatcher); the engine releases the stage
+  credit when a frame parks at one.
+- Per-stage **occupancy accounting** (busy-time integration over a
+  resettable window) feeds the ``stage_occupancy_*`` bench keys and the
+  profiler's ``stage:`` spans -- the direct evidence that two stages
+  ran concurrently.
+
+Scope note: stage credits are held in graph-path order and released
+forward, so admission is deadlock-free on acyclic paths.  A Loop element
+that jumps BACK across two placed stages while both windows are full
+could stall; placed stages inside loop bodies should size
+``stage_inflight`` above the loop's frame concurrency.
+
+In-order delivery: stage-parallel frames complete out of walk order
+(async stages, per-stage workers), so the engine assigns every ingested
+frame a per-stream delivery sequence and buffers responses until all
+predecessors responded (``Pipeline._deliver``) -- callers see ingest
+order, always.
+"""
+
+from __future__ import annotations
+
+import time
+
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+from ..utils import get_logger
+
+__all__ = ["StageScheduler", "StageExecutor", "STAGE_INFLIGHT_DEFAULT",
+           "STAGE_PIPELINE_MODES"]
+
+_logger = get_logger("aiko.stages")
+
+# Default per-stage admission window (double buffering: one frame
+# executing on the stage's submesh, one hopping/queued behind it).
+# Override with the ``stage_inflight`` pipeline parameter.
+STAGE_INFLIGHT_DEFAULT = 2
+
+STAGE_PIPELINE_MODES = ("auto", "off")
+
+
+class StageExecutor:
+    """One FIFO worker thread for one placed stage (a thin wrapper over
+    ``ThreadPoolExecutor(max_workers=1)``).
+
+    Jobs are closures the engine builds (element call or fused-segment
+    dispatch + a mailbox post of the continuation); the single thread
+    serializes a stage's execution -- per-stream order through the stage
+    is the queue order -- while different stages' threads run
+    concurrently, which is what lets synchronous placed stages overlap
+    in wall time."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.executed = 0
+        self._stopped = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"stage-{name}")
+
+    def submit(self, job) -> None:
+        if self._stopped:       # teardown: streams are already gone
+            return
+        self._pool.submit(self._run, job)
+
+    def _run(self, job) -> None:
+        try:
+            job()
+        except Exception:           # jobs carry their own error path;
+            _logger.exception(      # this is the backstop
+                "stage %s: worker job raised", self.name)
+        self.executed += 1
+
+    def stop(self):
+        self._stopped = True
+        self._pool.shutdown(wait=False)
+
+
+class StageScheduler:
+    """Credit-based per-stage admission + occupancy accounting.
+
+    Owned by the event loop: every method except the workers' own job
+    bodies runs on the pipeline's actor thread, so no locking.  The
+    waiter tokens are opaque ``(stream_id, frame_id, node_name)``
+    triples the engine re-posts as ``enter_stage_frame`` continuations.
+    """
+
+    def __init__(self, stages, depth: int = STAGE_INFLIGHT_DEFAULT):
+        self.depth = max(1, int(depth))
+        self.stages = list(stages)
+        self._active: dict[str, int] = {s: 0 for s in self.stages}
+        self._waiters: dict[str, deque] = {s: deque() for s in self.stages}
+        # Credits promised to POPPED waiter tokens whose resume posts
+        # are still in the mailbox: fresh admissions must not steal
+        # them, or a later frame overtakes an earlier one through the
+        # stage (the reorder buffer would still order the RESPONSES,
+        # but a stateful stage element would see frames out of order).
+        self._reserved: dict[str, int] = {s: 0 for s in self.stages}
+        self._executors: dict[str, StageExecutor] = {}
+        # Occupancy: integrate the time each stage has >= 1 admitted
+        # frame, over a resettable window (bench resets at the start of
+        # its timed pass).
+        self._busy: dict[str, float] = {s: 0.0 for s in self.stages}
+        self._busy_since: dict[str, float | None] = \
+            {s: None for s in self.stages}
+        self._window_start = time.monotonic()
+        self.admitted: dict[str, int] = {s: 0 for s in self.stages}
+        self.queued: dict[str, int] = {s: 0 for s in self.stages}
+
+    # -- workers -----------------------------------------------------------
+
+    def executor(self, stage: str) -> StageExecutor:
+        worker = self._executors.get(stage)
+        if worker is None:
+            worker = self._executors[stage] = StageExecutor(stage)
+        return worker
+
+    # -- admission window --------------------------------------------------
+
+    def try_admit(self, stage: str, reserved: bool = False) -> bool:
+        """``reserved`` marks the admission attempt of a popped waiter
+        token, which consumes its reservation; a fresh attempt may only
+        take capacity BEYOND the outstanding reservations (the reserved
+        credits belong to earlier queued frames), but genuinely free
+        surplus stays usable."""
+        if reserved:
+            self.cancel_reservation(stage)
+        elif self._active.get(stage, 0) \
+                + self._reserved.get(stage, 0) >= self.depth:
+            return False
+        if self._active.get(stage, 0) >= self.depth:
+            return False
+        self._active[stage] = self._active.get(stage, 0) + 1
+        self.admitted[stage] = self.admitted.get(stage, 0) + 1
+        if self._active[stage] == 1:
+            self._busy_since[stage] = time.monotonic()
+        return True
+
+    def cancel_reservation(self, stage: str) -> None:
+        if self._reserved.get(stage, 0) > 0:
+            self._reserved[stage] -= 1
+
+    def enqueue(self, stage: str, token, front: bool = False) -> None:
+        """FIFO wait queue for a full stage; ``front`` requeues a token
+        whose freed credit was stolen by an interleaving admission, so
+        queue order (and per-stream frame order) is preserved."""
+        waiters = self._waiters.setdefault(stage, deque())
+        if front:
+            waiters.appendleft(token)
+        else:
+            self.queued[stage] = self.queued.get(stage, 0) + 1
+            waiters.append(token)
+
+    def release(self, stage: str):
+        """Return one credit; returns the next waiter token to resume
+        (or None)."""
+        if self._active.get(stage, 0) > 0:
+            self._active[stage] -= 1
+            if self._active[stage] == 0 \
+                    and self._busy_since.get(stage) is not None:
+                self._busy[stage] = self._busy.get(stage, 0.0) + \
+                    time.monotonic() - self._busy_since[stage]
+                self._busy_since[stage] = None
+        return self.next_waiter(stage)
+
+    def next_waiter(self, stage: str):
+        """Pop the next waiter when an unreserved credit is available
+        (used both on release and when a popped waiter turned out
+        dead); the popped token takes a reservation on that credit
+        until its admission post lands."""
+        waiters = self._waiters.get(stage)
+        if waiters and self._active.get(stage, 0) \
+                + self._reserved.get(stage, 0) < self.depth:
+            self._reserved[stage] = self._reserved.get(stage, 0) + 1
+            return waiters.popleft()
+        return None
+
+    def waiting(self, stage: str) -> int:
+        return len(self._waiters.get(stage, ()))
+
+    def active(self, stage: str) -> int:
+        return self._active.get(stage, 0)
+
+    # -- occupancy ---------------------------------------------------------
+
+    def reset_window(self) -> None:
+        now = time.monotonic()
+        for stage in self.stages:
+            self._busy[stage] = 0.0
+            if self._busy_since.get(stage) is not None:
+                self._busy_since[stage] = now
+        self._window_start = now
+
+    def occupancy(self, stage: str) -> float:
+        wall = time.monotonic() - self._window_start
+        if wall <= 0:
+            return 0.0
+        busy = self._busy.get(stage, 0.0)
+        if self._busy_since.get(stage) is not None:
+            busy += time.monotonic() - self._busy_since[stage]
+        return min(1.0, busy / wall)
+
+    # -- reporting ---------------------------------------------------------
+
+    @property
+    def stats(self) -> dict:
+        return {stage: {"active": self._active.get(stage, 0),
+                        "admitted": self.admitted.get(stage, 0),
+                        "queued": self.queued.get(stage, 0),
+                        "waiting": self.waiting(stage),
+                        "reserved": self._reserved.get(stage, 0),
+                        "occupancy": round(self.occupancy(stage), 4)}
+                for stage in self.stages}
+
+    def stop(self):
+        for worker in self._executors.values():
+            worker.stop()
+        self._executors.clear()

@@ -54,6 +54,23 @@ class Frame:
     paused_pe_name: str | None = None    # set while parked at a remote stage
     response_topic: str | None = None    # where process_frame_response goes
     created: float = field(default_factory=time.monotonic)
+    # Stage-parallel execution (pipeline/stages.py): the placed stage
+    # this frame currently holds an admission credit for, and the
+    # StagePlacement generation it was admitted under (a replace() bump
+    # between admissions means the frame re-enters on fresh submeshes).
+    stage: str | None = None
+    stage_generation: int = 0
+    # The stage this frame is QUEUED for (admission denied, waiting for
+    # a credit).  Popped waiter tokens are validated against it: a
+    # stale token from a destroyed stream must never admit a recreated
+    # stream's same-id frame mid-pipeline.
+    stage_waiting: str | None = None
+    # Undiscovered-remote-stage retries (exponential backoff): how many
+    # times this frame has re-posted waiting for discovery.
+    remote_retries: int = 0
+    # In-order per-stream delivery: ingest-order sequence assigned when
+    # stage-parallel execution is active (None -> respond immediately).
+    delivery_seq: int | None = None
     # Provenance: bare swag key -> producer element name, for every
     # value an element of THIS frame wrote.  Fused segments consult it
     # before donating a buffer -- ingest/user data is never donatable
@@ -92,6 +109,14 @@ class Stream:
     fuse: str = "auto"
     fusion_plans: dict = field(default_factory=dict)
     fusion_segments: dict = field(default_factory=dict)
+    # In-order per-stream delivery under stage-parallel execution
+    # (pipeline/stages.py): frames respond in ingest order even though
+    # they complete stage-pipelined.  ``delivery_count`` hands out
+    # sequence numbers at ingest; ``delivery_next``/``delivery_pending``
+    # form the reorder buffer drained by ``Pipeline._deliver``.
+    delivery_count: int = 0
+    delivery_next: int = 0
+    delivery_pending: dict = field(default_factory=dict)
 
     def next_frame_id(self) -> int:
         frame_id = self.frame_count

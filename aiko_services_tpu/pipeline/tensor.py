@@ -26,12 +26,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..parallel.mesh import MeshPlan, P, make_mesh
+from ..parallel.mesh import MeshPlan, NamedSharding, P, make_mesh
 from .element import PipelineElement
 from .stream import Stream, StreamEvent
 
 __all__ = ["ShapeBucketer", "JitCache", "StagePlacement", "TPUElement",
-           "encode_array", "decode_array", "tree_device_put"]
+           "encode_array", "decode_array", "tree_device_put",
+           "device_sort_key"]
 
 
 # ---------------------------------------------------------------------------
@@ -137,97 +138,239 @@ class JitCache:
 # ---------------------------------------------------------------------------
 # Stage placement: pipeline stages onto disjoint chip submeshes.
 
+def device_sort_key(device):
+    """ICI-topology order for carving contiguous stage chunks: TPU chip
+    ``coords`` (x, y, z) then core, so consecutive devices in the sorted
+    pool are ICI neighbours and adjacent stages' chunks touch.  Devices
+    without coords (CPU/GPU virtual devices) fall back to id order,
+    which is the enumeration order of the virtual mesh."""
+    coords = getattr(device, "coords", None)
+    if coords is not None:
+        try:
+            return (0, tuple(int(c) for c in coords),
+                    int(getattr(device, "core_on_chip", 0) or 0))
+        except (TypeError, ValueError):
+            pass
+    return (1, (), int(getattr(device, "id", 0)))
+
+
 class StagePlacement:
     """Carve the local device set into per-stage submeshes.
 
     The reference deploys stages into other OS processes found by
     ServiceFilter (reference pipeline.py:246-258); on TPU a stage lands
-    on a group of local chips instead.  ``assign`` partitions devices
-    contiguously (contiguity = ICI neighbours on a pod) and returns a
-    ``MeshPlan`` per stage; ``transfer`` reshards a frame's tensors onto
-    the next stage's mesh -- on TPU this is a pure ICI copy.
+    on a group of local chips instead.  ``assign`` partitions the
+    topology-sorted device pool contiguously (``device_sort_key``: chip
+    coords, so "contiguous" means ICI neighbours and adjacent stages are
+    ICI-adjacent) and returns a ``MeshPlan`` per stage; ``transfer``
+    reshards a frame's tensors onto the next stage's mesh -- on TPU a
+    pure ICI copy, dispatched asynchronously (``jax.device_put`` does
+    not block) with the ``NamedSharding`` memoized per
+    (stage, generation, spec) and already-resident leaves passed through
+    untouched.
+
+    A stage may request ``"auto"`` devices: after fixed requests are
+    carved, the remaining pool splits across the auto stages
+    proportionally to their measured per-element cost
+    (``record_cost``, fed from profiled element spans; equal split
+    until profiles exist).  ``replace()`` re-resolves auto splits
+    against the survivors, so the balance tracks both the profile and
+    the shrinking pool.
     """
 
     def __init__(self, devices: Sequence | None = None):
-        self.devices = list(devices if devices is not None
-                            else jax.devices())
+        self.devices = sorted(devices if devices is not None
+                              else jax.devices(), key=device_sort_key)
         self.plans: dict[str, MeshPlan] = {}
-        self._requests: dict[str, dict[str, int]] = {}
+        self._requests: dict = {}
         self.generation = 0             # bumped by every replace()
+        self.costs: dict[str, float] = {}    # stage -> EMA seconds/frame
+        self._shardings: dict = {}      # (stage, generation, spec) memo
+        self.transfer_puts = 0          # leaves actually moved
+        self.transfer_skipped = 0       # leaves already resident
 
-    def assign(self, stages: dict[str, dict[str, int] | int]) \
-            -> dict[str, MeshPlan]:
-        """stages: name -> chip count or {axis: size} mesh request."""
+    # -- carving -----------------------------------------------------------
+
+    @staticmethod
+    def _normalize(stages: dict) -> dict:
         requests = {}
         for name, want in stages.items():
-            axes = {"dp": want} if isinstance(want, int) else dict(want)
-            requests[name] = axes
-        total = sum(int(np.prod(list(axes.values())))
-                    for axes in requests.values())
-        if total > len(self.devices):
+            if isinstance(want, str):
+                if want.strip().lower() != "auto":
+                    raise ValueError(
+                        f"stage {name!r}: device request must be a chip "
+                        f"count, a mesh dict, or 'auto', got {want!r}")
+                requests[name] = "auto"
+            else:
+                requests[name] = {"dp": want} if isinstance(want, int) \
+                    else dict(want)
+        return requests
+
+    def _resolve(self, requests: dict, pool: int) -> dict[str, dict]:
+        """Resolve ``auto`` requests into concrete mesh requests against
+        a pool of ``pool`` devices (each auto stage gets >= 1 chip; the
+        free chips split proportionally to recorded per-stage cost)."""
+        fixed_total = sum(int(np.prod(list(axes.values())))
+                          for axes in requests.values() if axes != "auto")
+        auto = [name for name, axes in requests.items() if axes == "auto"]
+        if fixed_total + len(auto) > pool:
             raise ValueError(
-                f"stages want {total} devices, have {len(self.devices)}")
+                f"stages want {fixed_total + len(auto)} devices, "
+                f"have {pool}")
+        shares: dict[str, int] = {}
+        if auto:
+            free = pool - fixed_total
+            weights = {name: max(float(self.costs.get(name, 0.0)), 0.0)
+                       for name in auto}
+            if not any(weights.values()):
+                weights = {name: 1.0 for name in auto}   # unprofiled
+            else:
+                # A stage with no profile yet gets the smallest known
+                # weight rather than zero chips.
+                floor = min(w for w in weights.values() if w > 0)
+                weights = {name: (w if w > 0 else floor)
+                           for name, w in weights.items()}
+            total_w = sum(weights.values())
+            shares = {name: max(1, int(free * weights[name] / total_w))
+                      for name in auto}
+            # Largest-remainder fit to exactly ``free`` chips.
+            while sum(shares.values()) > free:
+                name = max((n for n in auto if shares[n] > 1),
+                           key=lambda n: shares[n])
+                shares[name] -= 1
+            while sum(shares.values()) < free:
+                name = max(auto, key=lambda n: (
+                    free * weights[n] / total_w - shares[n]))
+                shares[name] += 1
+        return {name: ({"dp": shares[name]} if axes == "auto"
+                       else dict(axes))
+                for name, axes in requests.items()}
+
+    def assign(self, stages: dict, costs: dict | None = None) \
+            -> dict[str, MeshPlan]:
+        """stages: name -> chip count, {axis: size} mesh request, or
+        ``"auto"``.  ``costs`` (stage -> seconds) seeds the profile the
+        auto split balances on."""
+        if costs:
+            for name, seconds in costs.items():
+                self.record_cost(name, float(seconds))
+        requests = self._normalize(stages)
+        resolved = self._resolve(requests, len(self.devices))
         self._requests = requests
+        self.plans = {}
         cursor = 0
-        for name, axes in requests.items():
+        for name, axes in resolved.items():
             count = int(np.prod(list(axes.values())))
             chunk = self.devices[cursor:cursor + count]
             cursor += count
             self.plans[name] = MeshPlan(make_mesh(axes, chunk))
         return self.plans
 
+    def record_cost(self, stage: str, seconds: float) -> None:
+        """EMA of the measured per-frame cost of a stage (fed from the
+        engine's element spans); ``devices: auto`` splits re-balance on
+        it at the next assign()/replace()."""
+        prior = self.costs.get(stage)
+        self.costs[stage] = float(seconds) if prior is None \
+            else 0.75 * prior + 0.25 * float(seconds)
+
     def replace(self, failed_devices: Sequence) -> dict[str, MeshPlan]:
         """Re-place every stage onto the surviving devices (SURVEY.md
         §5.3 TPU-equiv: re-shard onto surviving chips).
 
-        Failed devices leave the pool permanently; stage mesh requests
-        shrink by halving their largest axis (power-of-two steps keep
-        dp/tp/fsdp shardings valid) until the total fits the survivors.
-        Plans are rebuilt in place -- elements must drop cached plans
-        and re-put weights (``TPUElement.on_replacement``)."""
+        Failed devices leave the pool permanently (survivors keep their
+        topology-sorted order, so chunks stay ICI-contiguous); fixed
+        stage requests shrink by halving their largest axis
+        (power-of-two steps keep dp/tp/fsdp shardings valid) until the
+        total fits, and ``auto`` stages re-split the remaining pool by
+        recorded cost.  Plans are rebuilt in place -- elements must drop
+        cached plans and re-put weights
+        (``TPUElement.on_replacement``)."""
         failed = set(failed_devices)
         survivors = [d for d in self.devices if d not in failed]
         if len(survivors) == len(self.devices):
             return self.plans
         if not survivors:
             raise RuntimeError("no surviving devices to re-place onto")
-        requests = {name: dict(axes)
+        requests = {name: (axes if axes == "auto" else dict(axes))
                     for name, axes in self._requests.items()}
+        n_auto = sum(1 for axes in requests.values() if axes == "auto")
 
-        def total(reqs):
+        def fixed_total(reqs):
             return sum(int(np.prod(list(axes.values())))
-                       for axes in reqs.values())
+                       for axes in reqs.values() if axes != "auto")
 
-        while total(requests) > len(survivors):
-            # Shrink the stage holding the most chips, on its largest
-            # axis; every request bottoms out at one chip.
-            name = max(requests,
+        while fixed_total(requests) + n_auto > len(survivors):
+            # Shrink the fixed stage holding the most chips, on its
+            # largest axis; every request bottoms out at one chip.
+            shrinkable = [name for name, axes in requests.items()
+                          if axes != "auto"
+                          and int(np.prod(list(axes.values()))) > 1]
+            if not shrinkable:
+                raise RuntimeError(
+                    f"cannot shrink stages below one device "
+                    f"({len(survivors)} survivors for "
+                    f"{len(requests)} stages)")
+            name = max(shrinkable,
                        key=lambda n: int(np.prod(
                            list(requests[n].values()))))
             axes = requests[name]
             axis = max(axes, key=axes.get)
-            if axes[axis] <= 1:
-                raise RuntimeError(
-                    f"cannot shrink stage {name!r} below one device "
-                    f"({len(survivors)} survivors for "
-                    f"{len(requests)} stages)")
             axes[axis] = max(1, axes[axis] // 2)
         self.devices = survivors
-        self.plans = {}
-        self.assign(requests)
+        self._shardings.clear()
         self.generation += 1
+        self.assign(requests)
         return self.plans
 
     def plan(self, stage: str) -> MeshPlan:
         return self.plans[stage]
 
+    # -- stage hops --------------------------------------------------------
+
+    def stage_sharding(self, stage: str, spec: tuple = ()) -> NamedSharding:
+        """The memoized NamedSharding frames reshard onto when hopping
+        to ``stage`` -- built once per (stage, generation, spec), not
+        per frame."""
+        key = (stage, self.generation, tuple(spec) if spec else None)
+        sharding = self._shardings.get(key)
+        if sharding is None:
+            plan = self.plans[stage]
+            sharding = plan.shard(*spec) if spec else plan.replicated()
+            self._shardings[key] = sharding
+        return sharding
+
     def transfer(self, value, to_stage: str, *spec):
-        """Reshard ``value`` (array or pytree) onto a stage's mesh."""
-        plan = self.plans[to_stage]
-        sharding = plan.shard(*spec) if spec else plan.replicated()
-        return jax.tree_util.tree_map(
-            lambda leaf: jax.device_put(leaf, sharding)
-            if hasattr(leaf, "shape") else leaf, value)
+        """Reshard ``value`` (array or pytree) onto a stage's mesh.
+
+        Non-blocking: ``jax.device_put`` dispatches the ICI copy and
+        returns immediately, so the hop overlaps the upstream stage's
+        next-frame compute.  Leaves whose committed sharding already IS
+        the target sharding pass through untouched (kills the per-frame
+        no-op device_put walk for values resident on the stage)."""
+        sharding = self.stage_sharding(to_stage, spec)
+
+        def hop(leaf):
+            if not hasattr(leaf, "shape"):
+                return leaf
+            if getattr(leaf, "sharding", None) == sharding:
+                self.transfer_skipped += 1
+                return leaf
+            self.transfer_puts += 1
+            return jax.device_put(leaf, sharding)
+
+        return jax.tree_util.tree_map(hop, value)
+
+    @property
+    def stats(self) -> dict:
+        return {"generation": self.generation,
+                "stages": {name: int(plan.mesh.devices.size)
+                           for name, plan in self.plans.items()},
+                "costs_ms": {name: round(cost * 1000.0, 3)
+                             for name, cost in self.costs.items()},
+                "transfer_puts": self.transfer_puts,
+                "transfer_skipped": self.transfer_skipped,
+                "shardings_cached": len(self._shardings)}
 
 
 def tree_device_put(tree, plan: MeshPlan, spec: P | None = None):

@@ -74,7 +74,8 @@ class Profiler:
 
     def attach(self, pipeline):
         """Annotate every element run -- and every fused-segment
-        dispatch -- of ``pipeline`` on the trace."""
+        dispatch, stage occupancy window and stage hop -- of
+        ``pipeline`` on the trace."""
         pipeline.add_hook_handler("pipeline.process_element:0",
                                   self._on_element)
         pipeline.add_hook_handler("pipeline.process_element_post:0",
@@ -83,6 +84,12 @@ class Profiler:
                                   self._on_segment)
         pipeline.add_hook_handler("pipeline.process_segment_post:0",
                                   self._on_segment_post)
+        pipeline.add_hook_handler("pipeline.process_stage:0",
+                                  self._on_stage)
+        pipeline.add_hook_handler("pipeline.process_stage_post:0",
+                                  self._on_stage_post)
+        pipeline.add_hook_handler("pipeline.stage_hop:0",
+                                  self._on_stage_hop)
         self._pipelines.append(pipeline)
 
     def detach(self):
@@ -95,6 +102,12 @@ class Profiler:
                                          self._on_segment)
             pipeline.remove_hook_handler("pipeline.process_segment_post:0",
                                          self._on_segment_post)
+            pipeline.remove_hook_handler("pipeline.process_stage:0",
+                                         self._on_stage)
+            pipeline.remove_hook_handler("pipeline.process_stage_post:0",
+                                         self._on_stage_post)
+            pipeline.remove_hook_handler("pipeline.stage_hop:0",
+                                         self._on_stage_hop)
         self._pipelines.clear()
         self._unwind()
 
@@ -152,6 +165,43 @@ class Profiler:
             annotation = self._open.pop(key, None)
             if annotation is not None:
                 annotation.__exit__(None, None, None)
+
+    # -- stage occupancy / hop spans -----------------------------------------
+
+    @staticmethod
+    def _stage_key(variables):
+        return ("stage", variables.get("stage"), variables.get("stream"),
+                variables.get("frame"))
+
+    def _on_stage(self, component, hook, variables):
+        """One ``stage:`` span per (stage, stream, frame) admission --
+        overlapping spans for the same stage across frames (window
+        depth >= 2), and concurrently-open spans for DIFFERENT stages,
+        are exactly the stage-parallel signature on the timeline."""
+        key = self._stage_key(variables)
+        stale = self._open.pop(key, None)
+        if stale is not None:           # same frame re-admitted (retry)
+            stale.__exit__(None, None, None)
+        annotation = jax.profiler.TraceAnnotation(
+            f"stage:{variables.get('stage')}")
+        annotation.__enter__()
+        self._open[key] = annotation
+
+    def _on_stage_post(self, component, hook, variables):
+        annotation = self._open.pop(self._stage_key(variables), None)
+        if annotation is not None:
+            annotation.__exit__(None, None, None)
+
+    @staticmethod
+    def _on_stage_hop(component, hook, variables):
+        # The hop already dispatched (device_put is async; the ICI copy
+        # itself rides the device timeline): a zero-width ``hop:`` mark
+        # locates it on the host track, with the dispatch cost carried
+        # in the hook's ``ms`` variable.
+        annotation = jax.profiler.TraceAnnotation(
+            f"hop:{variables.get('stage')}")
+        annotation.__enter__()
+        annotation.__exit__(None, None, None)
 
     def _unwind(self):
         while self._open:

@@ -113,6 +113,12 @@ def tree_bytes(tree) -> int:
     return sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(tree))
 
 
+def metrics_p50(rows, key) -> float:
+    """Median of one metrics key over (metrics, okay) response rows."""
+    values = sorted(metrics.get(key, 0.0) for metrics, _ in rows)
+    return values[len(values) // 2] if values else 0.0
+
+
 # ---------------------------------------------------------------------------
 # 1. Control plane: 3-stage chained pipelines (the multitude topology).
 
@@ -833,9 +839,7 @@ def bench_pipeline_e2e() -> dict:
     host_elapsed, host_snapshot = elapsed, snapshot
 
     def p50(key, rows=None):
-        values = sorted(metrics.get(key, 0.0)
-                        for metrics, _ in (rows or snapshot))
-        return values[len(values) // 2]
+        return metrics_p50(rows or snapshot, key)
 
     result = {
         "pipeline_e2e_fps": round(len(snapshot) / elapsed, 2),
@@ -1068,6 +1072,141 @@ def bench_pipeline_fusion() -> dict:
     for key in ("pipeline_e2e_dispatch_overhead_ms",
                 "pipeline_e2e_fused_fps",
                 "fused_compile_cold_ms", "fused_compile_warm_ms"):
+        prior = previous.get(key)
+        if prior:
+            result[f"{key}_vs_baseline"] = round(result[key] / prior, 2)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# 4c. Stage-parallel execution (ISSUE 3): a 2-stage PLACED pipeline
+#     (detect submesh -> llm submesh) through the real engine, the
+#     stage-parallel scheduler vs the serial stage-by-stage walk
+#     (``stage_pipeline: off``) side by side.  The synthetic StageWork
+#     stages carry a host-blocking wait standing in for a stage whose
+#     wall time is waiting on its chips -- exactly the shape the serial
+#     walk serializes and per-stage workers overlap.  Records per-stage
+#     occupancy over the timed window, the hop dispatch cost, and the
+#     hop-overlap window (time a frame's resharded inputs sat behind
+#     the previous frame's stage compute -- hop riding along for free).
+
+STAGE_FRAMES = 24
+STAGE_BUSY_MS = 20.0
+
+
+def bench_pipeline_stages() -> dict:
+    import numpy as np
+    import jax
+
+    if len(jax.devices()) < 2:
+        return {"pipeline_stages_skipped":
+                f"needs >= 2 devices, have {len(jax.devices())}"}
+    from aiko_services_tpu.pipeline import Pipeline
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.transport import reset_broker
+
+    reset_broker()
+    reset_process()
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    n = len(jax.devices())
+
+    def definition(mode):
+        return {
+            "version": 0, "name": f"bench_stages_{mode}",
+            "runtime": "jax",
+            "graph": ["(detect llm)"],
+            "parameters": {"transfer_guard": "disallow",
+                           "device_inflight": 3,
+                           "stage_pipeline": mode},
+            "elements": [
+                {**element("detect", "StageWork", ["x"], ["x"],
+                           {"busy_ms": STAGE_BUSY_MS, "factor": 2.0}),
+                 "placement": {"devices": n // 2}},
+                {**element("llm", "StageWork", ["x"], ["x"],
+                           {"busy_ms": STAGE_BUSY_MS, "factor": 3.0}),
+                 "placement": {"devices": n - n // 2}},
+            ]}
+
+    rng = np.random.default_rng(0)
+    frames = [rng.standard_normal((64, 64)).astype(np.float32)
+              for _ in range(4)]
+
+    def run_mode(mode):
+        pipeline = Pipeline(definition(mode), runtime=runtime)
+        responses: "queue.Queue" = queue.Queue()
+        collected: list = []
+
+        def pump(count):
+            for i in range(count):
+                pipeline.process_frame_local(
+                    {"x": frames[i % len(frames)]},
+                    stream_id=f"stages_{mode}",
+                    queue_response=responses)
+
+        def drain(target):
+            while not responses.empty():
+                collected.append(responses.get())
+            return len(collected) >= target
+
+        pump(4)                                     # warm the jits
+        runtime.run(until=lambda: drain(4), timeout=600.0)
+        if len(collected) < 4:
+            pipeline.stop()
+            return None, {}, f"{mode} warmup stalled"
+        collected.clear()
+        if pipeline.stage_scheduler is not None:
+            pipeline.stage_scheduler.reset_window()
+        start = time.perf_counter()
+        pump(STAGE_FRAMES)
+        runtime.run(until=lambda: drain(STAGE_FRAMES), timeout=600.0)
+        elapsed = time.perf_counter() - start
+        stats = pipeline.stage_stats()
+        ordered = [row[1] for row in collected]
+        okay = all(row[4] for row in collected)
+        pipeline.stop()
+        if len(collected) < STAGE_FRAMES or not okay:
+            return None, {}, f"{mode} pass incomplete"
+        rows = [(row[3], row[4]) for row in collected]
+        return (elapsed, rows, ordered == sorted(ordered)), stats, None
+
+    result: dict = {}
+    pipelined, stage_stats, error = run_mode("auto")
+    if error:
+        runtime.terminate()
+        return {"pipeline_stages_error": error}
+    serial, _stats_off, error = run_mode("off")
+    runtime.terminate()
+    if error:
+        return {"pipeline_stages_error": error}
+
+    pipelined_elapsed, pipelined_rows, in_order = pipelined
+    serial_elapsed, _serial_rows, _ = serial
+    fps = STAGE_FRAMES / pipelined_elapsed
+    serial_fps = STAGE_FRAMES / serial_elapsed
+    result.update({
+        "pipeline_stages_fps": round(fps, 2),
+        "pipeline_stages_serial_fps": round(serial_fps, 2),
+        # The acceptance ratio: steady-state throughput approaching the
+        # slower stage's solo rate instead of the sum of both stages.
+        "pipeline_stages_speedup": round(fps / serial_fps, 2)
+        if serial_fps else None,
+        "pipeline_stages_in_order": bool(in_order),
+        "stage_occupancy_detect":
+            stage_stats.get("detect", {}).get("occupancy"),
+        "stage_occupancy_llm":
+            stage_stats.get("llm", {}).get("occupancy"),
+        # Hop dispatch cost on the loop (device_put is async) and the
+        # overlap window the hop rides: queue time behind the previous
+        # frame's stage compute.
+        "stage_hop_dispatch_ms": round(
+            metrics_p50(pipelined_rows, "llm_hop_ms"), 3),
+        "hop_overlap_ms": round(
+            metrics_p50(pipelined_rows, "llm_queue_ms"), 2),
+    })
+    previous = _previous_bench()
+    for key in ("pipeline_stages_fps", "pipeline_stages_speedup",
+                "hop_overlap_ms"):
         prior = previous.get(key)
         if prior:
             result[f"{key}_vs_baseline"] = round(result[key] / prior, 2)
@@ -1338,6 +1477,7 @@ def main() -> int:
             ("bench_llm", lambda: bench_llm(peak, rtt)),
             ("bench_pipeline_e2e", bench_pipeline_e2e),
             ("bench_pipeline_fusion", bench_pipeline_fusion),
+            ("bench_pipeline_stages", bench_pipeline_stages),
             ("bench_asr", lambda: bench_asr(rtt)),
             ("bench_speech_e2e", bench_speech_e2e)):
         try:

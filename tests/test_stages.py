@@ -1,0 +1,660 @@
+"""Stage-parallel execution over placed submeshes (ISSUE 3): per-stage
+admission windows, stage-worker overlap of synchronous placed stages,
+in-order per-stream delivery, topology/profile-aware placement, memoized
+async stage hops, remote-retry backoff, and replace() under
+stage-parallel flight -- on the 8-device CPU mesh."""
+
+import queue
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import run_until
+
+from aiko_services_tpu.pipeline import Pipeline
+from aiko_services_tpu.pipeline.stages import StageScheduler
+from aiko_services_tpu.pipeline.tensor import StagePlacement, device_sort_key
+
+COMMON = "aiko_services_tpu.elements.common"
+
+import threading
+
+from aiko_services_tpu.pipeline import PipelineElement, StreamEvent
+
+
+class SlowAsync(PipelineElement):
+    """Async element tracking its peak concurrent parked frames --
+    loaded by module path ("tests/test_stages.py")."""
+
+    is_async = True
+    _lock = threading.Lock()
+    inflight = 0
+    peak = 0
+
+    def process_frame(self, stream, x=None):
+        return StreamEvent.OKAY, {"x": x}
+
+    def process_frame_start(self, stream, complete, x=None):
+        with SlowAsync._lock:
+            SlowAsync.inflight += 1
+            SlowAsync.peak = max(SlowAsync.peak, SlowAsync.inflight)
+
+        def work():
+            time.sleep(0.05)
+            with SlowAsync._lock:
+                SlowAsync.inflight -= 1
+            complete(StreamEvent.OKAY, {"x": x})
+
+        threading.Thread(target=work, daemon=True).start()
+
+
+def element(name, cls, inputs, outputs, parameters=None, placement=None,
+            module=COMMON):
+    definition = {"name": name,
+                  "input": [{"name": n} for n in inputs],
+                  "output": [{"name": n} for n in outputs],
+                  "deploy": {"local": {"module": module,
+                                       "class_name": cls}},
+                  "parameters": parameters or {}}
+    if placement:
+        definition["placement"] = placement
+    return definition
+
+
+def two_stage_definition(busy_a=20.0, busy_b=20.0, parameters=None,
+                         devices_a=4, devices_b=4):
+    return {
+        "version": 0, "name": "p_stages", "runtime": "jax",
+        "graph": ["(detect llm)"],
+        "parameters": dict(parameters or {}),
+        "elements": [
+            element("detect", "StageWork", ["x"], ["x"],
+                    {"busy_ms": busy_a, "factor": 2.0},
+                    {"devices": devices_a}),
+            element("llm", "StageWork", ["x"], ["x"],
+                    {"busy_ms": busy_b, "factor": 3.0},
+                    {"devices": devices_b}),
+        ]}
+
+
+def pump_and_drain(runtime, pipeline, n_frames, stream_id="s",
+                   timeout=30.0):
+    responses = queue.Queue()
+    for i in range(n_frames):
+        pipeline.process_frame_local(
+            {"x": np.full((8, 8), float(i + 1), np.float32)},
+            stream_id=stream_id, queue_response=responses)
+    collected = []
+
+    def drained():
+        while not responses.empty():
+            collected.append(responses.get())
+        return len(collected) >= n_frames
+
+    assert run_until(runtime, drained, timeout=timeout), \
+        f"only {len(collected)}/{n_frames} frames completed"
+    return collected
+
+
+# -- the tentpole: cross-stage pipelining -----------------------------------
+
+def test_two_stage_overlap_and_in_order_delivery(runtime):
+    """Steady state: frame k+1's detect span starts BEFORE frame k's llm
+    span ends (both stages concurrently busy), while responses arrive in
+    ingest order."""
+    pipeline = Pipeline(two_stage_definition(), runtime=runtime)
+    assert pipeline.stage_scheduler is not None
+    collected = pump_and_drain(runtime, pipeline, 6)
+
+    frame_ids = [row[1] for row in collected]
+    assert frame_ids == sorted(frame_ids), \
+        f"delivery out of ingest order: {frame_ids}"
+    for *_, okay, diagnostic in collected:
+        assert okay, diagnostic
+    spans = {}
+    for _, frame_id, _swag, metrics, *_ in collected:
+        spans[frame_id] = metrics
+    overlaps = 0
+    for k in range(len(spans) - 1):
+        llm_end = spans[k]["llm_time_start"] + spans[k]["llm_time"]
+        if spans[k + 1]["detect_time_start"] < llm_end:
+            overlaps += 1
+    assert overlaps >= 2, (
+        f"no cross-stage overlap: detect(k+1) never started before "
+        f"llm(k) ended ({overlaps} overlaps in {len(spans)} frames)")
+    # Occupancy accounting saw both stages busy.
+    stats = pipeline.stage_stats()
+    assert stats["detect"]["admitted"] >= 6
+    assert stats["llm"]["admitted"] >= 6
+    assert stats["detect"]["occupancy"] > 0
+    assert stats["llm"]["occupancy"] > 0
+    pipeline.stop()
+
+
+def test_stage_pipeline_throughput_vs_serial_walk(runtime):
+    """The acceptance ratio: stage-parallel fps >= 1.5x the serial
+    stage-walk baseline (``stage_pipeline: off``) on the synthetic
+    two-stage workload -- throughput approaches the slower stage's solo
+    rate instead of the sum of both stages."""
+    frames = 12
+
+    def run_mode(mode, name):
+        definition = two_stage_definition(
+            busy_a=25.0, busy_b=25.0,
+            parameters={"stage_pipeline": mode})
+        definition["name"] = name
+        pipeline = Pipeline(definition, runtime=runtime)
+        pump_and_drain(runtime, pipeline, 2, stream_id="warm")  # warm jit
+        start = time.perf_counter()
+        pump_and_drain(runtime, pipeline, frames, stream_id="timed")
+        elapsed = time.perf_counter() - start
+        pipeline.stop()
+        return frames / elapsed
+
+    serial_fps = run_mode("off", "p_serial")
+    pipelined_fps = run_mode("auto", "p_pipelined")
+    assert pipelined_fps >= 1.5 * serial_fps, (
+        f"stage pipelining {pipelined_fps:.1f} fps vs serial "
+        f"{serial_fps:.1f} fps: below the 1.5x acceptance ratio")
+
+
+def test_stage_admission_window_bounds_inflight(runtime):
+    """depth=1: at most one frame inside each stage at a time, queued
+    frames counted, and everything still completes in order."""
+    # llm deliberately slower than detect so frames always ARRIVE at a
+    # still-busy llm window (a symmetric split would race the release).
+    pipeline = Pipeline(two_stage_definition(
+        busy_a=5.0, busy_b=20.0,
+        parameters={"stage_inflight": 1}), runtime=runtime)
+    assert pipeline.stage_scheduler.depth == 1
+    collected = pump_and_drain(runtime, pipeline, 5)
+    assert [row[1] for row in collected] == sorted(
+        row[1] for row in collected)
+    stats = pipeline.stage_stats()
+    for stage in ("detect", "llm"):
+        assert stats[stage]["active"] == 0          # all released
+        assert stats[stage]["admitted"] >= 5
+    assert stats["llm"]["queued"] >= 1, \
+        "a full depth-1 window never queued a frame"
+    pipeline.stop()
+
+
+def test_single_placed_stage_has_no_scheduler(runtime):
+    """One placed stage has nothing to overlap with: the per-element
+    path (and immediate responses) stay exactly as before."""
+    definition = {
+        "version": 0, "name": "p_single", "runtime": "jax",
+        "graph": ["(only)"],
+        "elements": [element("only", "StageWork", ["x"], ["x"],
+                             {"factor": 2.0}, {"devices": 4})]}
+    pipeline = Pipeline(definition, runtime=runtime)
+    assert pipeline.stage_scheduler is None
+    collected = pump_and_drain(runtime, pipeline, 2)
+    assert all(okay for *_, okay, _d in collected)
+    pipeline.stop()
+
+
+def test_stage_local_fused_segment_runs_on_stage_worker(runtime):
+    """A fusable device chain AFTER a placed head fuses stage-locally
+    (segment.stage_context = the head's stage) and dispatches on that
+    stage's worker thread -- one fused dispatch per frame, results
+    identical to per-element, delivery in order."""
+    definition = {
+        "version": 0, "name": "p_fused_stage", "runtime": "jax",
+        "graph": ["(detect llm dbl inc)"],
+        "parameters": {"transfer_guard": "disallow"},
+        "elements": [
+            element("detect", "StageWork", ["x"], ["x"],
+                    {"busy_ms": 5.0, "factor": 2.0}, {"devices": 4}),
+            element("llm", "StageWork", ["x"], ["x"],
+                    {"busy_ms": 5.0, "factor": 3.0}, {"devices": 4}),
+            element("dbl", "DeviceDouble", ["x"], ["x"],
+                    module="tests/test_fusion.py"),
+            element("inc", "DeviceAddOne", ["x"], ["x"],
+                    module="tests/test_fusion.py"),
+        ]}
+    pipeline = Pipeline(definition, runtime=runtime)
+    collected = pump_and_drain(runtime, pipeline, 4)
+    assert [row[1] for row in collected] == [0, 1, 2, 3]
+    for _, frame_id, swag, metrics, okay, diagnostic in collected:
+        assert okay, diagnostic
+        expected = (frame_id + 1) * 2.0 * 3.0 * 2.0 + 1.0
+        np.testing.assert_allclose(np.asarray(swag["x"])[0, 0], expected)
+        assert metrics.get("fused_segments") == 1
+    assert len(pipeline.fused_segments) == 1
+    segment = pipeline.fused_segments[0]
+    assert segment.stage_context == "llm"
+    assert segment.calls == 4
+    assert not segment.broken
+    # The segment dispatched on the llm stage's worker, not the loop.
+    worker = pipeline.stage_scheduler.executor("llm")
+    assert worker.executed >= 4
+    pipeline.stop()
+
+
+# -- topology- and profile-aware placement ----------------------------------
+
+def test_devices_sorted_by_coords_with_id_fallback():
+    class FakeDevice:
+        def __init__(self, id, coords=None):
+            self.id = id
+            self.coords = coords
+
+    a = FakeDevice(3, (1, 0, 0))
+    b = FakeDevice(1, (0, 1, 0))
+    c = FakeDevice(2, (0, 0, 0))
+    placement = StagePlacement([a, b, c])
+    assert placement.devices == [c, b, a]       # coords order, not id
+    plain = StagePlacement([FakeDevice(2), FakeDevice(0), FakeDevice(1)])
+    assert [d.id for d in plain.devices] == [0, 1, 2]
+    # jax CPU devices sort by id (no coords) and stay stable.
+    placement = StagePlacement(list(reversed(jax.devices())))
+    assert [d.id for d in placement.devices] == list(range(8))
+
+
+def test_auto_split_equal_until_profiled():
+    placement = StagePlacement(jax.devices())
+    plans = placement.assign({"a": "auto", "b": "auto"})
+    assert {name: plan.mesh.devices.size
+            for name, plan in plans.items()} == {"a": 4, "b": 4}
+
+
+def test_auto_split_proportional_to_cost_and_rebalanced_on_replace():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"a": "auto", "b": "auto"},
+                     costs={"a": 0.010, "b": 0.030})
+    sizes = {name: plan.mesh.devices.size
+             for name, plan in placement.plans.items()}
+    assert sizes == {"a": 2, "b": 6}
+    # Two of b's chips die: the auto split re-balances over the 6
+    # survivors with the same 1:3 profile.
+    dead = list(placement.plans["b"].mesh.devices.flat)[:2]
+    placement.replace(dead)
+    sizes = {name: plan.mesh.devices.size
+             for name, plan in placement.plans.items()}
+    assert sum(sizes.values()) == 6
+    assert sizes["b"] > sizes["a"]
+    assert placement.generation == 1
+
+
+def test_auto_split_with_fixed_stage():
+    placement = StagePlacement(jax.devices())
+    plans = placement.assign({"fixed": {"tp": 2}, "x": "auto",
+                              "y": "auto"})
+    assert plans["fixed"].mesh.shape["tp"] == 2
+    assert plans["x"].mesh.devices.size + plans["y"].mesh.devices.size \
+        == 6
+
+
+def test_auto_split_overflow_rejected():
+    placement = StagePlacement(jax.devices())
+    with pytest.raises(ValueError, match="want"):
+        placement.assign({"fixed": 8, "auto_stage": "auto"})
+
+
+# -- memoized, resident-skipping stage hops ---------------------------------
+
+def test_transfer_memoizes_shardings_and_skips_resident_leaves():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"a": {"dp": 4}, "b": {"dp": 4}})
+    x = jnp.ones((8, 8))
+    on_b = placement.transfer(x, "b")
+    puts = placement.transfer_puts
+    cached = len(placement._shardings)
+    assert cached == 1
+    # Same stage again: sharding memo hit, and the already-resident
+    # leaf passes through untouched (no device_put walk).
+    again = placement.transfer(on_b, "b")
+    assert again is not None
+    assert placement.transfer_puts == puts          # nothing moved
+    assert placement.transfer_skipped >= 1
+    assert len(placement._shardings) == cached
+    # Hopping to the OTHER stage is a real move.
+    on_a = placement.transfer(on_b, "a")
+    assert placement.transfer_puts == puts + 1
+    np.testing.assert_array_equal(np.asarray(on_a), np.asarray(x))
+
+
+def test_transfer_sharding_cache_invalidated_by_replace():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"a": {"dp": 4}, "b": {"dp": 4}})
+    before = placement.transfer(jnp.ones((4, 4)), "b")
+    placement.replace(list(placement.plans["a"].mesh.devices.flat)[:2])
+    after = placement.transfer(before, "b")
+    survivors = set(placement.devices)
+    assert set(after.sharding.device_set) <= survivors
+
+
+# -- remote-stage retry backoff ---------------------------------------------
+
+def test_remote_retry_exponential_backoff(runtime):
+    from aiko_services_tpu.services import Registrar
+
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    front = Pipeline(
+        {"version": 0, "name": "front_backoff", "runtime": "jax",
+         "graph": ["(inc fwd)"],
+         "elements": [
+             element("inc", "Increment", ["x"], ["x"]),
+             {"name": "fwd", "input": [{"name": "x"}],
+              "output": [{"name": "x"}],
+              "deploy": {"remote": {"name": "never_appears"}}}]},
+        runtime=runtime)
+    responses = queue.Queue()
+    front.create_stream_local("1", queue_response=responses)
+    front.ingest_local("1", {"x": 0}, queue_response=responses)
+    runtime.run(timeout=1.8)
+    frame = front.streams["1"].frames[0]
+    # Fixed 0.25 s retries would have fired ~7 times by 1.8 s; backoff
+    # (0.25, 0.5, 1.0, ...) fires at most 4 -- and the count is VISIBLE
+    # on the share dict, not a silent hot loop.
+    assert 1 <= frame.remote_retries <= 4, frame.remote_retries
+    assert front.share["remote_stage_retries"] == frame.remote_retries
+    assert frame.metrics["remote_retries"] == frame.remote_retries
+    assert front.streams["1"].in_flight == 1        # still parked
+    front.stop()
+
+
+# -- replace() under stage-parallel flight ----------------------------------
+
+def test_replace_under_stage_parallel_execution(runtime):
+    """Chips die between bursts of a stage-parallel stream: in-flight
+    frames complete (or error) cleanly, and frames after the
+    replacement run on the NEW generation's submeshes -- never against
+    a stale mesh."""
+    pipeline = Pipeline(two_stage_definition(busy_a=5.0, busy_b=5.0),
+                        runtime=runtime)
+    placement = pipeline.stage_placement
+    collected = pump_and_drain(runtime, pipeline, 4)
+    assert all(okay for *_, okay, _d in collected)
+    assert placement.generation == 0
+
+    detect_devices = list(placement.plans["detect"].mesh.devices.flat)
+    dead = set(detect_devices[:2])
+    failed = pipeline.check_device_health(prober=lambda d: d not in dead)
+    assert set(failed) == dead
+    assert placement.generation == 1
+
+    collected = pump_and_drain(runtime, pipeline, 4, stream_id="s2")
+    for *_, okay, diagnostic in collected:
+        assert okay, diagnostic
+    survivors = set(placement.devices)
+    assert not survivors & dead
+    for _, _fid, swag, metrics, *_ in collected:
+        leaf = swag["x"]
+        assert set(leaf.sharding.device_set) <= survivors, \
+            "frame ran against a stale (pre-replacement) mesh"
+    # The new generation's hops filled a fresh sharding cache.
+    assert all(key[1] == 1 for key in placement._shardings)
+    pipeline.stop()
+
+
+def test_replace_midflight_frames_never_use_stale_mesh(runtime):
+    """Frames IN FLIGHT across the replacement: every output that
+    completes after the swap is resident on surviving devices only."""
+    pipeline = Pipeline(two_stage_definition(busy_a=15.0, busy_b=15.0),
+                        runtime=runtime)
+    placement = pipeline.stage_placement
+    responses = queue.Queue()
+    for i in range(6):
+        pipeline.process_frame_local(
+            {"x": np.full((8, 8), float(i + 1), np.float32)},
+            stream_id="mid", queue_response=responses)
+    detect_devices = list(placement.plans["detect"].mesh.devices.flat)
+    dead = set(detect_devices[:2])
+
+    # Inject the failure while frames are mid-pipeline: run the loop
+    # briefly, then health-check from the loop via the actor mailbox.
+    runtime.run(timeout=0.03)
+    pipeline.check_device_health(prober=lambda d: d not in dead)
+    collected = []
+
+    def drained():
+        while not responses.empty():
+            collected.append(responses.get())
+        return len(collected) >= 6
+
+    run_until(runtime, drained, timeout=30.0)
+    survivors = set(placement.devices)
+    new_generation = 0
+    for _, _fid, swag, metrics, okay, diagnostic in collected:
+        if not okay:
+            continue        # erroring cleanly at the swap is legal
+        leaf = swag.get("x")
+        if metrics.get("stage_llm_generation") == 1:
+            # Admitted to llm AFTER the swap: must be on the new
+            # submeshes, never the stale mesh.
+            new_generation += 1
+            assert hasattr(leaf, "sharding")
+            assert set(leaf.sharding.device_set) <= survivors, \
+                "post-replacement frame ran against a stale mesh"
+    assert new_generation >= 1, \
+        "no frame demonstrably re-entered at the new generation"
+    pipeline.stop()
+
+
+# -- failure paths must not wedge the (pipeline-global) window ---------------
+
+def test_frame_error_releases_credits_for_other_streams(runtime):
+    """A poison frame errors its stream while other frames are parked
+    on stage workers / queued for admission: every stage credit comes
+    back, and a FRESH stream still flows (leaked credits would wedge
+    every stream at the stage)."""
+    pipeline = Pipeline(two_stage_definition(busy_a=10.0, busy_b=10.0),
+                        runtime=runtime)
+    responses = queue.Queue()
+    for i in range(3):
+        pipeline.process_frame_local(
+            {"x": np.full((4, 4), float(i + 1), np.float32)},
+            stream_id="s1", queue_response=responses)
+    # Poison: StageWork's jitted multiply raises on None input (on the
+    # stage worker), erroring the stream with frames still in flight.
+    pipeline.process_frame_local({"x": None}, stream_id="s1",
+                                 queue_response=responses)
+    for i in range(2):
+        pipeline.process_frame_local(
+            {"x": np.full((4, 4), 1.0, np.float32)},
+            stream_id="s1", queue_response=responses)
+    collected = []
+
+    def saw_error():
+        while not responses.empty():
+            collected.append(responses.get())
+        return any(not row[4] for row in collected)
+
+    assert run_until(runtime, saw_error, timeout=30.0)
+    runtime.run(timeout=0.3)            # let teardown posts drain
+    stats = pipeline.stage_stats()
+    for stage in ("detect", "llm"):
+        assert stats[stage]["active"] == 0, \
+            f"stage {stage} leaked admission credits: {stats[stage]}"
+        assert pipeline.stage_scheduler.waiting(stage) == 0
+    # The window still admits: a new stream completes all its frames.
+    fresh = pump_and_drain(runtime, pipeline, 4, stream_id="s2")
+    for *_, okay, diagnostic in fresh:
+        assert okay, diagnostic
+    pipeline.stop()
+
+
+def test_error_flushes_buffered_successor_responses(runtime):
+    """A frame error must not drop the buffered okay-responses of
+    successors that already completed out of order: the error delivers
+    in its slot and the finished work flushes behind it."""
+    from aiko_services_tpu.pipeline.stream import Frame
+
+    pipeline = Pipeline(two_stage_definition(), runtime=runtime)
+    responses = queue.Queue()
+    stream = pipeline.create_stream_local("w", queue_response=responses)
+    f0, f1 = Frame(frame_id=0), Frame(frame_id=1)
+    pipeline._assign_delivery_seq(stream, f0)
+    pipeline._assign_delivery_seq(stream, f1)
+    stream.frames[0] = f0
+    # Frame 1 completes FIRST: its response buffers behind frame 0.
+    pipeline._deliver(stream, f1, okay=True)
+    assert responses.empty()
+    pipeline._frame_error(stream, f0, "boom")
+    got = [responses.get_nowait() for _ in range(2)]
+    assert [row[1] for row in got] == [0, 1]        # seq order kept
+    assert got[0][4] is False and "boom" in got[0][5]
+    assert got[1][4] is True, "successor's completed response was lost"
+    pipeline.stop()
+
+
+def test_bad_devices_request_is_definition_error(runtime):
+    from aiko_services_tpu.pipeline.definition import DefinitionError
+
+    definition = two_stage_definition()
+    definition["elements"][0]["placement"] = {"devices": "atuo"}  # typo
+    with pytest.raises(DefinitionError, match="devices"):
+        Pipeline(definition, runtime=runtime)
+
+
+def test_stream_recreated_with_same_id_runs_full_path(runtime):
+    """Destroy a stream mid-flight (queued waiters, parked workers),
+    recreate it under the SAME id: every new frame walks the FULL path
+    (stale waiter tokens must never admit a new frame mid-pipeline)."""
+    pipeline = Pipeline(two_stage_definition(
+        busy_a=5.0, busy_b=30.0,
+        parameters={"stage_inflight": 1}), runtime=runtime)
+    limbo = queue.Queue()
+    for i in range(3):
+        pipeline.process_frame_local(
+            {"x": np.full((4, 4), 1.0, np.float32)},
+            stream_id="r", queue_response=limbo)
+    runtime.run(timeout=0.05)           # frames spread across stages
+    pipeline.destroy_stream("r")
+    collected = pump_and_drain(runtime, pipeline, 3, stream_id="r",
+                               timeout=30.0)
+    for _, frame_id, swag, _metrics, okay, diagnostic in collected:
+        assert okay, diagnostic
+        # detect (x2) AND llm (x3) both ran exactly once:
+        # (frame_id + 1) * 2 * 3.
+        np.testing.assert_allclose(np.asarray(swag["x"])[0, 0],
+                                   (frame_id + 1) * 6.0)
+    pipeline.stop()
+
+
+# -- scheduler unit behaviour ------------------------------------------------
+
+def test_scheduler_credits_and_waiters():
+    scheduler = StageScheduler(["a", "b"], depth=2)
+    assert scheduler.try_admit("a")
+    assert scheduler.try_admit("a")
+    assert not scheduler.try_admit("a")             # window full
+    scheduler.enqueue("a", ("s", 1, "a"))
+    token = scheduler.release("a")
+    assert token == ("s", 1, "a")                   # freed credit -> waiter
+    assert scheduler.active("a") == 1
+    assert scheduler.stats["a"]["queued"] == 1
+    scheduler.stop()
+
+
+def test_in_stage_async_park_releases_stage_credit(runtime):
+    """An async element DEEPER in a stage (not the placed head) still
+    releases the stage credit at its park: cross-frame batching at the
+    async element must not be capped at the admission window depth."""
+    SlowAsync.inflight = 0
+    SlowAsync.peak = 0
+    definition = two_stage_definition(busy_a=1.0, busy_b=1.0)
+    definition["graph"] = ["(detect batcher llm)"]
+    definition["elements"].insert(1, element(
+        "batcher", "SlowAsync", ["x"], ["x"],
+        module="tests/test_stages.py"))
+    pipeline = Pipeline(definition, runtime=runtime)
+    # The element class is re-imported by module path: reach the live
+    # class through the graph, not the pytest import of this file.
+    live_cls = type(pipeline.graph.get_node("batcher").element)
+    live_cls.inflight = 0
+    live_cls.peak = 0
+    collected = pump_and_drain(runtime, pipeline, 6)
+    assert all(row[4] for row in collected)
+    assert live_cls.peak > pipeline.stage_scheduler.depth, (
+        f"peak {live_cls.peak} parked frames: detect credits were "
+        f"held through the in-stage async park")
+    pipeline.stop()
+
+
+def test_scheduler_reservation_blocks_queue_jumping():
+    """A popped waiter's freed credit is RESERVED until its admission
+    post lands: a fresh admission attempt arriving in between must not
+    steal it (a later frame would overtake an earlier one through a
+    stateful stage)."""
+    scheduler = StageScheduler(["a"], depth=1)
+    assert scheduler.try_admit("a")
+    scheduler.enqueue("a", ("s", 0, "a"))
+    token = scheduler.release("a")          # pops + reserves
+    assert token == ("s", 0, "a")
+    assert not scheduler.try_admit("a"), \
+        "fresh admission stole a popped waiter's reserved credit"
+    assert scheduler.try_admit("a", reserved=True)
+    assert scheduler.active("a") == 1
+    # A dead popped token cancels its reservation instead of pinning it.
+    scheduler.enqueue("a", ("s", 1, "a"))
+    token = scheduler.release("a")
+    scheduler.cancel_reservation("a")
+    assert scheduler.try_admit("a")         # credit usable again
+    scheduler.stop()
+
+
+def test_scheduler_fresh_admission_uses_surplus_beyond_reservations():
+    """A reservation pins exactly ONE credit: fresh admissions may
+    still take genuinely free capacity beyond active + reserved."""
+    scheduler = StageScheduler(["a"], depth=2)
+    assert scheduler.try_admit("a")
+    scheduler.enqueue("a", ("s", 0, "a"))
+    token = scheduler.release("a")          # active 0, reserved 1
+    assert token == ("s", 0, "a")
+    assert scheduler.try_admit("a"), \
+        "one reservation blocked the window's free surplus credit"
+    assert not scheduler.try_admit("a")     # active 1 + reserved 1 = depth
+    assert scheduler.try_admit("a", reserved=True)
+    scheduler.stop()
+
+
+def test_remote_park_releases_stage_credit(runtime):
+    """Frames parked at (or retrying discovery of) a remote stage
+    DOWNSTREAM of placed stages must not pin the placed stages'
+    admission windows: later frames keep flowing through the submeshes
+    while earlier ones wait on the fabric."""
+    from aiko_services_tpu.services import Registrar
+
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    definition = two_stage_definition(busy_a=2.0, busy_b=2.0)
+    definition["graph"] = ["(detect llm fwd)"]
+    definition["elements"].append(
+        {"name": "fwd", "input": [{"name": "x"}],
+         "output": [{"name": "x"}],
+         "deploy": {"remote": {"name": "never_appears"}}})
+    pipeline = Pipeline(definition, runtime=runtime)
+    responses = queue.Queue()
+    n_frames = 5                    # > 2x the default window depth
+    for i in range(n_frames):
+        pipeline.process_frame_local(
+            {"x": np.full((4, 4), 1.0, np.float32)},
+            stream_id="rp", queue_response=responses)
+    runtime.run(timeout=1.0)
+    stats = pipeline.stage_stats()
+    # Every frame cleared BOTH placed stages (parked/retrying at the
+    # remote now): with credits pinned across the remote park, only
+    # stage_inflight frames could ever have entered llm.
+    assert stats["llm"]["admitted"] == n_frames, stats
+    assert stats["llm"]["active"] == 0, \
+        f"remote park pinned llm admission credits: {stats['llm']}"
+    assert stats["detect"]["active"] == 0
+    assert pipeline.streams["rp"].in_flight == n_frames   # all parked
+    pipeline.stop()
+
+
+def test_scheduler_occupancy_window():
+    scheduler = StageScheduler(["a"], depth=1)
+    scheduler.try_admit("a")
+    time.sleep(0.03)
+    scheduler.release("a")
+    assert scheduler.occupancy("a") > 0
+    scheduler.reset_window()
+    time.sleep(0.01)
+    assert scheduler.occupancy("a") < 0.5           # idle since reset
+    scheduler.stop()
