@@ -40,6 +40,9 @@ _HOOK_ALIASES = {"pf": "pipeline.process_frame:0",
                  "pep": "pipeline.process_element_post:0",
                  "ps": "pipeline.process_segment:0",
                  "psp": "pipeline.process_segment_post:0",
+                 "pst": "pipeline.process_stage:0",
+                 "pstp": "pipeline.process_stage_post:0",
+                 "hop": "pipeline.stage_hop:0",
                  "rp": "pipeline.replacement:0"}
 
 
@@ -127,9 +130,16 @@ def pipeline():
               help="attach the default printing handler to hooks: "
                    "comma list of pf,pe,pep,rp,all (reference "
                    "pipeline.py:1613-1625)")
+@click.option("--metrics-port", default=None, type=int,
+              help="serve the telemetry plane over HTTP on this port "
+                   "(0 = assigned): /metrics Prometheus text, /traces "
+                   "recent distributed frame traces")
+@click.option("--metrics-host", default="127.0.0.1",
+              help="bind address for --metrics-port (default loopback; "
+                   "0.0.0.0 opts into remote scraping)")
 def pipeline_create(definition_pathname, transport, name, stream_id,
                     frame_data, parameters, frame_rate, profile_dir,
-                    hooks_spec):
+                    hooks_spec, metrics_port, metrics_host):
     """Create a Pipeline from DEFINITION_PATHNAME (JSON) and run it."""
     from .pipeline import create_pipeline
     from .utils import parse_value
@@ -143,6 +153,18 @@ def pipeline_create(definition_pathname, transport, name, stream_id,
 
         for hook_name in hook_names:
             instance.add_hook_handler(hook_name, default_hook_handler)
+    metrics_server = None
+    if metrics_port is not None:
+        from .observability import MetricsServer
+
+        if instance.telemetry is None:
+            raise click.ClickException(
+                "--metrics-port needs telemetry, but the definition "
+                "sets 'telemetry: off'")
+        metrics_server = MetricsServer(instance, metrics_port,
+                                       host=metrics_host)
+        click.echo(f"metrics on {metrics_host}:{metrics_server.port}"
+                   f"/metrics (traces on /traces)")
     profiler = None
     if profile_dir:
         from .tpu import Profiler
@@ -173,6 +195,8 @@ def pipeline_create(definition_pathname, transport, name, stream_id,
         if profiler is not None:
             profiler.detach()
             profiler.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
 
 
 @pipeline.command("list")

@@ -88,19 +88,56 @@ class RegistrarPlugin(ServicePlugin):
 
 class PipelinePlugin(ServicePlugin):
     """Pipeline vitals from its share dict: elements, streams, frame
-    counters, per-element parameters."""
+    counters, the telemetry plane's windowed percentiles, per-element
+    parameters."""
 
     title = "pipeline"
+
+    @staticmethod
+    def _telemetry_lines(view) -> list[str]:
+        """Windowed p50/p99 rollups the pipeline publishes under
+        ``share["telemetry"]`` (observability/telemetry.py) -- the
+        ECConsumer sees them for free; render the latency sections."""
+        telemetry = view.get("telemetry")
+        if not isinstance(telemetry, dict):
+            return []
+        lines = []
+        frame = telemetry.get("frame") or {}
+        if frame.get("count"):
+            lines.append(f"frame latency ms p50/p90/p99: "
+                         f"{frame.get('p50_ms')}/{frame.get('p90_ms')}"
+                         f"/{frame.get('p99_ms')} n={frame.get('count')}")
+        for section in ("element", "segment", "stage", "hop", "queue"):
+            entries = telemetry.get(section) or {}
+            if not isinstance(entries, dict) or not entries:
+                continue
+            lines.append(f"{section} latency ms (p50/p99):")
+            for name in sorted(entries):
+                entry = entries[name] or {}
+                if not isinstance(entry, dict):
+                    continue
+                lines.append(f"  {str(name):24.24s} "
+                             f"{entry.get('p50_ms')}/{entry.get('p99_ms')}"
+                             f" n={entry.get('count')}")
+        traces = telemetry.get("traces") or {}
+        if isinstance(traces, dict) and traces:
+            lines.append(f"traces: {traces.get('buffered')} buffered / "
+                         f"{traces.get('completed')} completed")
+        return lines
 
     def render(self, model, record):
         view = model.share_view
         lines = [f"element_count: {view.get('element_count', '?')}",
                  f"streams:       {view.get('streams', '?')}",
                  f"frames:        {view.get('frames_processed', '?')}"]
+        telemetry_lines = self._telemetry_lines(view)
+        if telemetry_lines:
+            lines.append("[telemetry]")
+            lines.extend(telemetry_lines)
         extras = [(name, value) for name, value in model.share_items()
                   if name.split(".")[0] not in
                   ("element_count", "streams", "frames_processed",
-                   "lifecycle", "log_level", "running")]
+                   "lifecycle", "log_level", "running", "telemetry")]
         if extras:
             lines.append("element shares:")
             lines.extend(f"  {name:32.32s} {value}"

@@ -1,0 +1,23 @@
+"""Observability plane: distributed frame tracing, streaming latency
+histograms, and the metrics export surface (ISSUE 4 tentpole).
+
+The reference framework's core value was its live shared-state
+observability (ECProducer share + Dashboard); the perf PRs added deep
+per-frame instrumentation but no aggregation.  This package closes the
+loop: hooks -> histograms/spans -> share + Prometheus text + traces.
+
+Import surface is jax-free: dashboards and exporters can use it without
+pulling in the TPU stack.
+"""
+
+from .metrics import (HISTOGRAM_WINDOW_DEFAULT, LogHistogram,
+                      MetricsRegistry)
+from .tracing import (TRACE_CAPACITY_DEFAULT, TraceBuffer, decode_spans,
+                      encode_spans, make_span, mint_id)
+from .telemetry import TELEMETRY_INTERVAL_DEFAULT, PipelineTelemetry
+from .exporter import MetricsServer
+
+__all__ = ["LogHistogram", "MetricsRegistry", "TraceBuffer",
+           "PipelineTelemetry", "MetricsServer", "make_span", "mint_id",
+           "encode_spans", "decode_spans", "HISTOGRAM_WINDOW_DEFAULT",
+           "TRACE_CAPACITY_DEFAULT", "TELEMETRY_INTERVAL_DEFAULT"]

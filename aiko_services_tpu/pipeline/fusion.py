@@ -45,12 +45,15 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
+import time
 from typing import Callable
 
 import jax
 
 from .element import PipelineElement, PipelineElementLoop
 from .tensor import JitCache
+from ..observability import LogHistogram
 from ..parallel.mesh import donate_argnums_supported
 from ..utils import get_logger
 
@@ -284,6 +287,13 @@ class FusedSegment:
         # bound-method object per access would never probe as a hit.
         self._traced_fn = self._traced
         self._call = self.jit_cache(self._traced_fn)
+        # Per-dispatch wall time (telemetry plane): dispatch-cost
+        # percentiles per segment.  LogHistogram itself is not
+        # thread-safe (it normally sits behind MetricsRegistry's
+        # lock); calls may come from the event loop OR a stage worker
+        # while jit_stats() reads from the loop, so guard it here.
+        self.dispatch_ms = LogHistogram()
+        self._dispatch_lock = threading.Lock()
 
     # -- planning ----------------------------------------------------------
 
@@ -419,13 +429,26 @@ class FusedSegment:
         outputs dict keyed ``element.name``."""
         keep, donate = self._split(resolved, donated)
         self.calls += 1
-        return self._call(keep, donate, self._captures)
+        start = time.perf_counter()
+        try:
+            return self._call(keep, donate, self._captures)
+        finally:
+            elapsed_ms = (time.perf_counter() - start) * 1000.0
+            with self._dispatch_lock:
+                self.dispatch_ms.observe(elapsed_ms)
 
     @property
     def stats(self) -> dict:
+        with self._dispatch_lock:
+            dispatch_p50 = self.dispatch_ms.quantile(0.5,
+                                                     windowed=False)
+            dispatch_p99 = self.dispatch_ms.quantile(0.99,
+                                                     windowed=False)
         return {"elements": [node.name for node in self.nodes],
                 "calls": self.calls, "broken": self.broken,
                 "donation": self.donation, "stage": self.stage_context,
+                "dispatch_p50_ms": dispatch_p50,
+                "dispatch_p99_ms": dispatch_p99,
                 "jit": self.jit_cache.stats}
 
     def __repr__(self):

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from collections import deque
 
 import jax
@@ -177,16 +178,23 @@ class DeviceWindow:
             self._inflight.append((frame_id, leaves))
             self.noted += 1
 
-    def pace(self, limit) -> None:
+    def pace(self, limit) -> float:
         """Block (oldest-first) until at most ``limit - 1`` frames stay
         outstanding, so the frame about to dispatch makes ``limit``.
-        ``limit`` <= 0 or None disables pacing (unbounded dispatch)."""
+        ``limit`` <= 0 or None disables pacing (unbounded dispatch).
+        Returns the seconds spent blocked (0.0 when nothing synced) --
+        the telemetry plane's ``ingest_pace_ms`` histogram, i.e. how
+        hard ingest is riding the dispatch window."""
         if not limit or limit <= 0:
-            return
+            return 0.0
+        if len(self._inflight) < limit:
+            return 0.0
+        start = time.perf_counter()
         while len(self._inflight) >= limit:
             _, leaves = self._inflight.popleft()
             jax.block_until_ready(leaves)
             self.synced += 1
+        return time.perf_counter() - start
 
     def drain(self) -> None:
         """Sync everything outstanding (stream flush, tests)."""

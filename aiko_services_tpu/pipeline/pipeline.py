@@ -38,6 +38,11 @@ from .stages import (STAGE_INFLIGHT_DEFAULT, STAGE_PIPELINE_MODES,
                      StageScheduler)
 from .stream import (Stream, Frame, StreamEvent, StreamState,
                      DEFAULT_STREAM_ID)
+from ..observability import (HISTOGRAM_WINDOW_DEFAULT,
+                             TELEMETRY_INTERVAL_DEFAULT,
+                             TRACE_CAPACITY_DEFAULT, PipelineTelemetry,
+                             decode_spans, encode_spans, make_span,
+                             mint_id)
 from ..runtime import Lease
 from ..services import Actor, ServiceFilter, get_service_proxy, do_discovery
 from ..services.service import SERVICE_PROTOCOL_PREFIX
@@ -141,6 +146,27 @@ class Pipeline(Actor):
         self.add_hook("pipeline.process_stage_post:0")
         self.add_hook("pipeline.stage_hop:0")
         self.add_hook("pipeline.replacement:0")
+
+        # Telemetry plane (observability/): latency histograms, frame
+        # traces and the export surface, fed by the hooks above.
+        # ``telemetry: off`` disables it wholesale (hot-path cost drops
+        # back to a no-handler hook probe per event).
+        telemetry_mode = str(definition.parameters.get(
+            "telemetry", "on")).strip().lower()
+        if telemetry_mode in ("off", "false", "0"):
+            self.telemetry = None
+        else:
+            self.telemetry = PipelineTelemetry(
+                self,
+                window_s=float(parse_number(
+                    definition.parameters.get("telemetry_window"),
+                    HISTOGRAM_WINDOW_DEFAULT)),
+                trace_capacity=int(parse_number(
+                    definition.parameters.get("trace_capacity"),
+                    TRACE_CAPACITY_DEFAULT)),
+                publish_interval=float(parse_number(
+                    definition.parameters.get("telemetry_interval"),
+                    TELEMETRY_INTERVAL_DEFAULT)))
 
         self._health_timer = None
         interval = self.definition.parameters.get("health_check_interval")
@@ -417,6 +443,22 @@ class Pipeline(Actor):
                 "dispatches": sum(s.calls for s in self.fused_segments),
                 "broken": sum(1 for s in self.fused_segments if s.broken)}
 
+    def metrics_text(self) -> str:
+        """Prometheus-style text exposition of the telemetry plane
+        (histogram quantiles, counters, engine gauges).  Empty when
+        ``telemetry: off``.  Safe to call from any thread -- this is
+        what the ``--metrics-port`` HTTP endpoint serves."""
+        if self.telemetry is None:
+            return ""
+        return self.telemetry.metrics_text()
+
+    def get_trace(self, trace_id: str) -> dict | None:
+        """One reconstructed trace (all spans, both processes for
+        remote hops) from the TraceBuffer, or None."""
+        if self.telemetry is None:
+            return None
+        return self.telemetry.traces.get(str(trace_id))
+
     # -- stream lifecycle --------------------------------------------------
 
     def create_stream(self, stream_id=None, *parameters):
@@ -591,6 +633,11 @@ class Pipeline(Actor):
                     self.logger.exception("stop_stream %s failed", node.name)
         finally:
             self._current_stream_ref = None
+        if self.telemetry is not None:
+            # After the release loop above: the spans it buffered for
+            # this dead incarnation must not leak onto a recreated
+            # same-id stream's frames (ids restart per stream).
+            self.telemetry.stream_destroyed(stream_id)
         self.ec_producer.update("streams", len(self.streams))
 
     # -- frame ingestion ---------------------------------------------------
@@ -621,12 +668,17 @@ class Pipeline(Actor):
             stream.queue_response = queue_response
         frame = Frame(frame_id=stream.next_frame_id(),
                       swag=dict(frame_data))
+        if self.telemetry is not None:
+            self.telemetry.frame_started(frame)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
         # Bounded dispatch window: before this frame's device work
         # enqueues, sync the oldest completed-but-unsynced frame(s) so
         # dispatch stays at most device_inflight frames ahead.
-        stream.device_window.pace(stream.device_inflight)
+        paced = stream.device_window.pace(stream.device_inflight)
+        if paced and self.telemetry is not None:
+            self.telemetry.registry.observe("ingest_pace_ms",
+                                            paced * 1000.0)
         self._process_frame_common(stream, frame)
 
     def _ingest(self, stream_dict: dict, frame_data: dict):
@@ -641,6 +693,13 @@ class Pipeline(Actor):
             frame_id = stream.next_frame_id()
         frame = Frame(frame_id=int(frame_id), swag=dict(frame_data))
         frame.response_topic = stream_dict.get("response_topic")
+        if self.telemetry is not None:
+            # A forwarded frame carries its origin's trace context: the
+            # spans stamped here join THAT trace (and ride back in the
+            # response) instead of starting a new one.
+            self.telemetry.frame_started(
+                frame, trace_id=stream_dict.get("trace_id"),
+                parent_id=stream_dict.get("trace_parent"))
         stale = stream.frames.get(frame.frame_id)
         if stale is not None:
             # A wire caller re-ingested a live frame id: the replaced
@@ -650,7 +709,10 @@ class Pipeline(Actor):
             self._deliver(stream, stale, okay=False, skip=True)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
-        stream.device_window.pace(stream.device_inflight)
+        paced = stream.device_window.pace(stream.device_inflight)
+        if paced and self.telemetry is not None:
+            self.telemetry.registry.observe("ingest_pace_ms",
+                                            paced * 1000.0)
         self._process_frame_common(stream, frame)
 
     def _assign_delivery_seq(self, stream: Stream, frame: Frame) -> None:
@@ -741,6 +803,7 @@ class Pipeline(Actor):
                     # doesn't match both (duplicates, stale posts and
                     # queued tokens from a destroyed same-id stream).
                     frame.stage_waiting = node.name
+                    frame.stage_wait_start = time.perf_counter()
                     self.post_self("enter_stage_frame",
                                    [stream.stream_id, frame.frame_id,
                                     node.name, False, frame])
@@ -1111,6 +1174,14 @@ class Pipeline(Actor):
                 if self.stage_placement is not None else 0
             frame.metrics[f"stage_{node_name}_admit"] = \
                 time.perf_counter()
+            if frame.stage_wait_start is not None:
+                # Admission wait: how long the frame sat behind the
+                # stage's credit window (the telemetry plane rolls
+                # these into the stage_admission_wait_ms histogram).
+                frame.metrics[f"stage_{node_name}_wait_ms"] = \
+                    (time.perf_counter() - frame.stage_wait_start) \
+                    * 1000.0
+                frame.stage_wait_start = None
             # Which placement generation this admission ran under --
             # the replace() test (and post-mortems) read it to prove a
             # frame re-entered on fresh submeshes, not a stale mesh.
@@ -1157,7 +1228,9 @@ class Pipeline(Actor):
         self.run_hook("pipeline.process_stage_post:0",
                       lambda: {"stage": stage,
                                "stream": stream.stream_id,
-                               "frame": frame.frame_id})
+                               "frame": frame.frame_id,
+                               "ms": frame.metrics.get(
+                                   f"stage_{stage}_ms", 0.0)})
         waiter = self.stage_scheduler.release(stage)
         if waiter is not None:
             self.post_self("enter_stage_frame", list(waiter))
@@ -1611,6 +1684,11 @@ class Pipeline(Actor):
         self.share["jit_cache_entries"] = entries
         self.share["fused_segments"] = len(self.fused_segments)
         self.share["fused_dispatches"] = dispatches
+        if self.telemetry is not None:
+            # BEFORE delivery: the root span (and any remote spans)
+            # must be on frame.spans when _respond encodes them back
+            # to a forwarding origin.
+            self.telemetry.frame_finished(stream, frame, okay=True)
         self._deliver(stream, frame, okay=True,
                       skip=bool(frame.metrics.get("dropped")))
         if stream.state == StreamState.STOP:
@@ -1664,6 +1742,8 @@ class Pipeline(Actor):
                           stream.stream_id, frame.frame_id, diagnostic)
         stream.frames.pop(frame.frame_id, None)
         self._release_stage(stream, frame)
+        if self.telemetry is not None:
+            self.telemetry.frame_finished(stream, frame, okay=False)
         stream.state = StreamState.ERROR
         if frame.delivery_seq is not None:
             # Deliver the error IN its slot so already-completed
@@ -1687,16 +1767,23 @@ class Pipeline(Actor):
             # values are fetched -- one explicit counted device_get for
             # the whole response, then the host-side codec.
             bare_swag = self.transfer_ledger.fetch(bare_swag)
-            payload = generate("process_frame_response", [
-                {"stream_id": stream.stream_id,
-                 "frame_id": frame.frame_id,
-                 "okay": okay, "diagnostic": diagnostic},
-                encode_frame_data(bare_swag)])
+            header = {"stream_id": stream.stream_id,
+                      "frame_id": frame.frame_id,
+                      "okay": okay, "diagnostic": diagnostic}
+            if frame.trace_remote and frame.spans:
+                # Forwarded frame: return this process's spans so the
+                # ORIGIN reconstructs the whole distributed trace.
+                header["spans"] = encode_spans(frame.spans)
+            payload = generate("process_frame_response",
+                               [header, encode_frame_data(bare_swag)])
             self.runtime.message.publish(frame.response_topic, payload)
         if stream.queue_response is not None:
+            # Snapshot: queue consumers read from other threads, and
+            # the live dict must stay loop-confined (see Frame.metrics).
             stream.queue_response.put(
                 (stream.stream_id, frame.frame_id,
-                 dict(frame.swag), frame.metrics, okay, diagnostic))
+                 dict(frame.swag), dict(frame.metrics), okay,
+                 diagnostic))
 
     # -- remote stage park / forward / resume ------------------------------
 
@@ -1711,10 +1798,22 @@ class Pipeline(Actor):
         forwarded = self.transfer_ledger.fetch(
             inputs if inputs else {
                 k: v for k, v in frame.swag.items() if "." not in k})
-        payload = generate("process_frame", [
-            {"stream_id": stream.stream_id, "frame_id": frame.frame_id,
-             "response_topic": self.topic_in},
-            encode_frame_data(forwarded)])
+        header = {"stream_id": stream.stream_id,
+                  "frame_id": frame.frame_id,
+                  "response_topic": self.topic_in}
+        if self.telemetry is not None and frame.trace_id is not None:
+            # Trace context rides the hop: the remote pipeline stamps
+            # its spans under this hop span's id and returns them in
+            # the response, so one trace_id covers both processes.  A
+            # RE-forward (remote lost mid-park, frame replayed) reuses
+            # the still-open hop span rather than leaking it.
+            if frame.remote_span is None \
+                    or frame.remote_span[0] != node.name:
+                frame.remote_span = (node.name, mint_id(), time.time())
+            header["trace_id"] = frame.trace_id
+            header["trace_parent"] = frame.remote_span[1]
+        payload = generate("process_frame",
+                           [header, encode_frame_data(forwarded)])
         self.runtime.message.publish(f"{stage.remote_topic_path}/in",
                                      payload)
         return True
@@ -1732,6 +1831,22 @@ class Pipeline(Actor):
         if frame is None or frame.paused_pe_name is None:
             return
         okay = str(stream_dict.get("okay", "true")).lower() != "false"
+        if self.telemetry is not None:
+            # Close the hop span and merge the remote pipeline's spans
+            # BEFORE the okay branch: an errored remote round trip
+            # still belongs on the trace.
+            if frame.remote_span is not None:
+                node_name, span_id, started = frame.remote_span
+                frame.remote_span = None
+                frame.spans.append(make_span(
+                    frame.trace_id or "", span_id, frame.trace_root,
+                    f"remote:{node_name}", "remote", self.name,
+                    stream.stream_id, frame.frame_id, started,
+                    (time.time() - started) * 1000.0,
+                    status="ok" if okay else "error"))
+            remote_spans = stream_dict.get("spans")
+            if remote_spans:
+                frame.spans.extend(decode_spans(remote_spans))
         if not okay:
             self._frame_error(stream, frame,
                               f"remote {frame.paused_pe_name}: "

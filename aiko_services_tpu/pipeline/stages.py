@@ -240,6 +240,12 @@ class StageScheduler:
                         "queued": self.queued.get(stage, 0),
                         "waiting": self.waiting(stage),
                         "reserved": self._reserved.get(stage, 0),
+                        "depth": self.depth,
+                        # Worker jobs the stage's executor completed --
+                        # with "admitted" this localizes a stall to
+                        # admission (credits) vs execution (worker).
+                        "executed": self._executors[stage].executed
+                        if stage in self._executors else 0,
                         "occupancy": round(self.occupancy(stage), 4)}
                 for stage in self.stages}
 

@@ -50,6 +50,13 @@ class StreamState(enum.Enum):
 class Frame:
     frame_id: int
     swag: dict = field(default_factory=dict)
+    # LOOP-CONFINED (audited, PR 4): every write happens on the
+    # pipeline's event loop -- stage workers and async elements hand
+    # timings back through mailbox continuations, never by mutating
+    # this dict from their own threads.  The telemetry plane reads it
+    # at frame completion on the loop, and responses carry a SNAPSHOT
+    # (Pipeline._respond) so queue consumers on other threads never
+    # share the live mapping.
     metrics: dict = field(default_factory=dict)
     paused_pe_name: str | None = None    # set while parked at a remote stage
     response_topic: str | None = None    # where process_frame_response goes
@@ -77,6 +84,27 @@ class Frame:
     # (the caller may still hold the array, e.g. a device-resident
     # image ring).
     produced: dict = field(default_factory=dict)
+    # Distributed frame tracing (observability/): trace_id + root span
+    # minted at ingest (or adopted from the forwarding process when the
+    # frame arrived over a RemoteStage hop -- trace_remote marks that
+    # this process must return its spans in the response).  ``spans``
+    # collects completed span dicts; like ``metrics`` it is
+    # LOOP-CONFINED: only the pipeline's event loop writes it (stage
+    # workers post continuations; hooks fire on the resumed turn).
+    trace_id: str | None = None
+    trace_parent: str | None = None
+    trace_root: str | None = None
+    trace_remote: bool = False
+    trace_start: float = 0.0
+    trace_done: bool = False
+    spans: list = field(default_factory=list)
+    # Perf stamp set when the frame starts waiting for a placed stage's
+    # admission credit; cleared into ``metrics["stage_<s>_wait_ms"]``
+    # when the admission lands.
+    stage_wait_start: float | None = None
+    # Open remote-hop span while parked at a RemoteStage:
+    # (node_name, span_id, wall start).
+    remote_span: tuple | None = None
 
 
 @dataclass

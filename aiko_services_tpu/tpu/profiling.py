@@ -14,6 +14,15 @@ the two (SURVEY.md §5.1 TPU-equiv note):
   the device ops it launched.
 - :func:`profile_trace` is the context-manager form for scripts/tests.
 
+Relation to the telemetry plane (``observability/``): the distributed
+frame traces (``trace_id`` + span dicts in the ``TraceBuffer``) and the
+annotations here describe the SAME events -- ``element:``/``segment:``/
+``stage:``/``hop:`` names match one-for-one.  The telemetry spans carry
+ids and cross process boundaries (a ``RemoteStage`` hop stitches both
+processes into one trace); the xprof annotations align those events
+with the device ops on the XLA timeline.  Debug latency with the
+trace/histograms, then zoom into a span's device work with xprof.
+
 CLI: ``python -m aiko_services_tpu pipeline create DEF --profile DIR``.
 """
 
@@ -204,6 +213,19 @@ class Profiler:
         annotation.__exit__(None, None, None)
 
     def _unwind(self):
+        """Close every dangling annotation INNERMOST-FIRST.
+
+        ``popitem()`` alone scrambled nested ``compile:``/``segment:``
+        pairs: ``_on_segment`` opens the outer ``compile:`` before the
+        inner ``segment:``, and a dict re-entry (same key popped and
+        re-inserted) can leave an outer span AFTER its inner one in
+        insertion order -- closing in raw pop order then exits the
+        outer annotation first and corrupts xprof's span nesting.  So:
+        all non-``compile`` spans close first (reverse insertion
+        order), then the remaining ``compile:`` outers."""
+        for key in [key for key in reversed(list(self._open))
+                    if key[0] != "compile"]:
+            self._open.pop(key).__exit__(None, None, None)
         while self._open:
             _, annotation = self._open.popitem()
             annotation.__exit__(None, None, None)

@@ -802,6 +802,10 @@ def bench_pipeline_e2e() -> dict:
         return {"pipeline_e2e_error":
                 f"warmup stalled at {len(collected)}/{warmed}"}
     collected.clear()
+    if pipeline.telemetry is not None:
+        # Percentiles must describe the timed passes, not the warmup's
+        # compile frames.
+        pipeline.telemetry.registry.reset()
 
     def timed_best_of(passes, pump_fn):
         """Run ``passes`` timed 24-frame passes, keep the fastest
@@ -882,6 +886,30 @@ def bench_pipeline_e2e() -> dict:
     transfer = pipeline.transfer_stats()
     result["swag_host_transfers"] = transfer["implicit"]
     result["swag_explicit_fetches"] = transfer["explicit"]
+    # Telemetry-plane percentiles (ISSUE 4): p99s out of the streaming
+    # histograms, not just medians of one pass -- the tail is where the
+    # tunnel spikes and batching stalls live.  Cumulative over the
+    # timed passes (registry reset after warmup).
+    if pipeline.telemetry is not None:
+        registry = pipeline.telemetry.registry
+
+        def hist(name, q, labels=None):
+            value = registry.quantile(name, q, labels, windowed=False)
+            return None if value is None else round(value, 2)
+
+        result["pipeline_e2e_p99_ms"] = hist("frame_latency_ms", 0.99)
+        for element_name, tag in (("DET", "detect"), ("CAP", "caption"),
+                                  ("LLM", "llm")):
+            result[f"pipeline_e2e_p99_{tag}_ms"] = hist(
+                "element_latency_ms", 0.99, {"element": element_name})
+        previous = _previous_bench()
+        for key in ("pipeline_e2e_p99_ms", "pipeline_e2e_p99_detect_ms",
+                    "pipeline_e2e_p99_caption_ms",
+                    "pipeline_e2e_p99_llm_ms"):
+            prior = previous.get(key)
+            if prior and result.get(key):
+                result[f"{key}_vs_baseline"] = round(
+                    result[key] / prior, 2)
     runtime.terminate()
     if device_best is None:
         result["pipeline_e2e_device_error"] = device_error
@@ -1157,11 +1185,26 @@ def bench_pipeline_stages() -> dict:
         collected.clear()
         if pipeline.stage_scheduler is not None:
             pipeline.stage_scheduler.reset_window()
+        if pipeline.telemetry is not None:
+            pipeline.telemetry.registry.reset()     # timed pass only
         start = time.perf_counter()
         pump(STAGE_FRAMES)
         runtime.run(until=lambda: drain(STAGE_FRAMES), timeout=600.0)
         elapsed = time.perf_counter() - start
         stats = pipeline.stage_stats()
+        if pipeline.telemetry is not None:
+            registry = pipeline.telemetry.registry
+            for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                value = registry.quantile("frame_latency_ms", q,
+                                          windowed=False)
+                if value is not None:
+                    stats[f"pipeline_stages_{tag}_ms"] = round(value, 2)
+            for stage in ("detect", "llm"):
+                value = registry.quantile("element_latency_ms", 0.99,
+                                          {"element": stage},
+                                          windowed=False)
+                if value is not None:
+                    stats[f"stage_{stage}_p99_ms"] = round(value, 2)
         ordered = [row[1] for row in collected]
         okay = all(row[4] for row in collected)
         pipeline.stop()
@@ -1204,11 +1247,18 @@ def bench_pipeline_stages() -> dict:
         "hop_overlap_ms": round(
             metrics_p50(pipelined_rows, "llm_queue_ms"), 2),
     })
+    # Histogram percentiles from the telemetry plane (timed pass only).
+    for key in ("pipeline_stages_p50_ms", "pipeline_stages_p99_ms",
+                "stage_detect_p99_ms", "stage_llm_p99_ms"):
+        if key in stage_stats:
+            result[key] = stage_stats.pop(key)
     previous = _previous_bench()
     for key in ("pipeline_stages_fps", "pipeline_stages_speedup",
-                "hop_overlap_ms"):
+                "hop_overlap_ms", "pipeline_stages_p50_ms",
+                "pipeline_stages_p99_ms", "stage_detect_p99_ms",
+                "stage_llm_p99_ms"):
         prior = previous.get(key)
-        if prior:
+        if prior and result.get(key):
             result[f"{key}_vs_baseline"] = round(result[key] / prior, 2)
     return result
 

@@ -187,3 +187,39 @@ def test_dashboard_kill_and_copy_actions(runtime):
     model.selected = f"{parts[0]}/elsewhere/12345/1"
     assert model.kill_selected(kill=lambda *a: killed.append(a)) is False
     assert len(killed) == 1
+
+
+def test_dashboard_pipeline_plugin_renders_telemetry():
+    """The pipeline plugin renders the telemetry.* rollup the pipeline
+    publishes on its share dict (values arrive as strings through the
+    ECConsumer; the renderer must not require numbers)."""
+    from aiko_services_tpu.dashboard import PipelinePlugin
+
+    class FakeModel:
+        share_view = {
+            "element_count": 2, "streams": 1, "frames_processed": 6,
+            "telemetry": {
+                "frame": {"count": "6", "p50_ms": "2.1",
+                          "p90_ms": "3.0", "p99_ms": "3.2"},
+                "element": {"A": {"count": "6", "p50_ms": "0.4",
+                                  "p99_ms": "0.9"}},
+                "stage": {}, "segment": {}, "hop": {}, "queue": {},
+                "traces": {"buffered": "6", "completed": "6"}}}
+
+        def share_items(self):
+            return []
+
+    lines = PipelinePlugin().render(FakeModel(), record=None)
+    joined = "\n".join(lines)
+    assert "[telemetry]" in joined
+    assert "frame latency ms p50/p90/p99: 2.1/3.0/3.2 n=6" in joined
+    assert any("A" in line and "0.4/0.9" in line for line in lines)
+    assert "traces: 6 buffered / 6 completed" in joined
+
+    # No telemetry published (telemetry: off): section omitted cleanly.
+    class BareModel(FakeModel):
+        share_view = {"element_count": 2, "streams": 0,
+                      "frames_processed": 0}
+
+    assert "[telemetry]" not in "\n".join(
+        PipelinePlugin().render(BareModel(), record=None))

@@ -1,0 +1,103 @@
+"""Static hook-registry consistency (ISSUE 4 satellite): every hook
+name registered via ``add_hook`` must have a matching ``run_hook``
+call site and vice versa, and every consumer-side reference
+(``add_hook_handler`` literals, CLI aliases) must point at a hook some
+component actually runs -- so span/metric hooks cannot silently drift
+when one side is renamed."""
+
+import pathlib
+import re
+
+PACKAGE = pathlib.Path(__file__).resolve().parent.parent \
+    / "aiko_services_tpu"
+
+# "component.hook_name:version" -- the naming convention every hook in
+# the tree follows (runtime/hooks.py).
+_HOOK_NAME = r"[a-z_][a-z0-9_.]*:\d+"
+_LITERAL = rf'"({_HOOK_NAME})"'
+# HOOK_MESSAGE_IN = "actor.message_in:0" style constants, so
+# add_hook(self.HOOK_X) / run_hook(self.HOOK_X) resolve too.
+_CONSTANT = re.compile(rf'\b(HOOK_[A-Z_0-9]+)\s*=\s*{_LITERAL}')
+
+
+def _sources():
+    for path in sorted(PACKAGE.rglob("*.py")):
+        yield path, path.read_text()
+
+
+def _collect(call: str) -> dict[str, set]:
+    """hook name -> set of 'file:line' sites for ``call(...)``."""
+    constants: dict[str, str] = {}
+    for _, text in _sources():
+        for name, value in _CONSTANT.findall(text):
+            constants[name] = value
+    sites: dict[str, set] = {}
+    pattern = re.compile(
+        rf'\b{call}\(\s*(?:{_LITERAL}|(?:self|cls)\.(HOOK_[A-Z_0-9]+))')
+    for path, text in _sources():
+        for line_number, line in enumerate(text.splitlines(), 1):
+            for literal, constant in pattern.findall(line):
+                name = literal or constants.get(constant)
+                if name is None:
+                    raise AssertionError(
+                        f"{path}:{line_number}: {call} uses unresolved "
+                        f"constant {constant!r}")
+                sites.setdefault(name, set()).add(
+                    f"{path.relative_to(PACKAGE)}:{line_number}")
+    return sites
+
+
+def test_every_registered_hook_is_invoked_and_vice_versa():
+    registered = _collect("add_hook")
+    invoked = _collect("run_hook")
+    assert registered, "no add_hook sites found -- pattern drift?"
+    orphans = {name: sorted(sites) for name, sites in registered.items()
+               if name not in invoked}
+    assert not orphans, \
+        f"hooks registered but never run (dead hooks): {orphans}"
+    ghosts = {name: sorted(sites) for name, sites in invoked.items()
+              if name not in registered}
+    assert not ghosts, \
+        f"hooks run but never registered (silent no-ops): {ghosts}"
+
+
+def test_handler_attachments_reference_live_hooks():
+    """add_hook_handler auto-registers, so a typo'd name would attach
+    a handler to a hook nothing ever runs -- catch it statically."""
+    invoked = set(_collect("run_hook"))
+    attachments = _collect("add_hook_handler")
+    stale = {name: sorted(sites) for name, sites in attachments.items()
+             if name not in invoked}
+    assert not stale, f"handlers attached to never-run hooks: {stale}"
+
+
+def test_cli_hook_aliases_reference_live_hooks():
+    from aiko_services_tpu.cli import _HOOK_ALIASES
+
+    invoked = set(_collect("run_hook"))
+    stale = {alias: name for alias, name in _HOOK_ALIASES.items()
+             if name not in invoked}
+    assert not stale, f"CLI aliases for never-run hooks: {stale}"
+
+
+def test_pipeline_telemetry_and_profiler_cover_same_hooks():
+    """The telemetry plane and the xprof profiler must stay in sync on
+    the span-bearing hooks: a hook one consumes and the other misses is
+    exactly the drift this check exists to catch."""
+    profiler_attach = set()
+    telemetry_attach = set()
+    for path, text in _sources():
+        names = set(re.findall(rf'"(pipeline\.[a-z_]+:\d+)"', text))
+        if path.name == "profiling.py":
+            profiler_attach = names
+        elif path.name == "telemetry.py":
+            telemetry_attach = names
+    span_hooks = {"pipeline.process_element:0",
+                  "pipeline.process_element_post:0",
+                  "pipeline.process_segment:0",
+                  "pipeline.process_segment_post:0",
+                  "pipeline.process_stage:0",
+                  "pipeline.process_stage_post:0",
+                  "pipeline.stage_hop:0"}
+    assert span_hooks <= profiler_attach
+    assert span_hooks <= telemetry_attach

@@ -1,0 +1,358 @@
+"""Telemetry plane (ISSUE 4): streaming log histograms, the metrics
+registry + Prometheus exposition, the TraceBuffer ring, share rollups,
+and the HTTP export surface -- plus the loop-confinement contract for
+``frame.metrics`` under concurrent readers."""
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from conftest import run_until
+
+from aiko_services_tpu.observability import (LogHistogram,
+                                             MetricsRegistry,
+                                             MetricsServer, TraceBuffer,
+                                             decode_spans, encode_spans,
+                                             make_span, mint_id)
+from aiko_services_tpu.pipeline import Pipeline
+
+COMMON = "aiko_services_tpu.elements.common"
+
+
+def element(name, cls, parameters=None, module=COMMON):
+    return {"name": name, "input": [{"name": "x"}],
+            "output": [{"name": "x"}],
+            "deploy": {"local": {"module": module, "class_name": cls}},
+            "parameters": parameters or {}}
+
+
+def simple_pipeline(runtime, name="p_obs", parameters=None):
+    return Pipeline({"version": 0, "name": name, "runtime": "jax",
+                     "graph": ["(A (B))"],
+                     "parameters": dict(parameters or {}),
+                     "elements": [element("A", "Increment"),
+                                  element("B", "Increment")]},
+                    runtime=runtime)
+
+
+def pump(runtime, pipeline, n, stream_id="s"):
+    responses = queue.Queue()
+    for i in range(n):
+        pipeline.process_frame_local({"x": i}, stream_id=stream_id,
+                                     queue_response=responses)
+    assert run_until(runtime, lambda: responses.qsize() >= n,
+                     timeout=20.0)
+    rows = [responses.get() for _ in range(n)]
+    assert all(row[4] for row in rows), rows
+    return rows
+
+
+# -- LogHistogram -----------------------------------------------------------
+
+def test_histogram_quantiles_bounded_error():
+    histogram = LogHistogram()
+    for value in range(1, 1001):          # 1..1000 ms uniform
+        histogram.observe(float(value))
+    assert histogram.count == 1000
+    for q, expected in ((0.5, 500.0), (0.9, 900.0), (0.99, 990.0)):
+        measured = histogram.quantile(q, windowed=False)
+        # log-bucket growth 2**0.25 -> relative error under ~10%
+        assert abs(measured - expected) / expected < 0.12, (q, measured)
+    summary = histogram.summary(windowed=False)
+    assert summary["count"] == 1000
+    assert summary["min_ms"] == 1.0 and summary["max_ms"] == 1000.0
+
+
+def test_histogram_extremes_and_window_rotation(monkeypatch):
+    histogram = LogHistogram(window_s=10.0)
+    histogram.observe(0.0)                 # underflow bucket
+    histogram.observe(1e9)                 # clamps to top bucket
+    assert histogram.quantile(0.0, windowed=False) is not None
+    # Force a rotation: old window values drop out of the windowed
+    # view after two windows, but stay in the cumulative view.
+    histogram.observe(5.0)
+    histogram._window_start -= 25.0        # two windows ago
+    histogram.observe(7.0)                 # triggers rotation
+    assert histogram.quantile(0.5, windowed=False) is not None
+    windowed = histogram.quantile(0.99, windowed=True)
+    assert windowed is not None and windowed <= 8.0  # 1e9 rotated out
+
+
+def test_empty_histogram():
+    histogram = LogHistogram()
+    assert histogram.quantile(0.5) is None
+    assert histogram.summary()["p99_ms"] is None
+
+
+# -- MetricsRegistry --------------------------------------------------------
+
+def test_registry_labels_and_render_text():
+    registry = MetricsRegistry()
+    registry.observe("element_latency_ms", 3.0, element="DET")
+    registry.observe("element_latency_ms", 30.0, element="LLM")
+    registry.count("frames_total", status="ok")
+    registry.count("frames_total", status="ok")
+    registry.gauge("streams_active", 2)
+    assert registry.quantile("element_latency_ms", 0.5,
+                             {"element": "DET"}) == pytest.approx(
+        3.0, rel=0.15)
+    text = registry.render_text()
+    assert "# TYPE aiko_element_latency_ms summary" in text
+    assert 'aiko_element_latency_ms{element="DET",quantile="0.5"}' in text
+    assert 'aiko_element_latency_ms_count{element="DET"} 1' in text
+    assert 'aiko_frames_total{status="ok"} 2' in text
+    assert "aiko_streams_active 2" in text
+    registry.reset()
+    assert registry.summaries() == []
+
+
+def test_registry_thread_safety_smoke():
+    registry = MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            registry.observe("latency_ms", i % 50 + 0.1, element="A")
+            registry.count("events")
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                registry.render_text()
+                registry.quantile("latency_ms", 0.99, {"element": "A"})
+            except Exception as error:      # pragma: no cover
+                errors.append(error)
+                return
+
+    threads = [threading.Thread(target=fn)
+               for fn in (writer, writer, reader, reader)]
+    for thread in threads:
+        thread.start()
+    time.sleep(0.3)
+    stop.set()
+    for thread in threads:
+        thread.join(timeout=5.0)
+    assert not errors
+
+
+# -- TraceBuffer / span codec -----------------------------------------------
+
+def test_trace_buffer_ring_and_merge():
+    buffer = TraceBuffer(capacity=3)
+    ids = [mint_id() for _ in range(4)]
+    for trace_id in ids:
+        buffer.add(trace_id, [make_span(trace_id, mint_id(), None,
+                                        "frame:0", "frame", "p", "s", 0,
+                                        time.time(), 1.0)])
+    assert len(buffer) == 3                      # oldest evicted
+    assert buffer.get(ids[0]) is None
+    # merge: same trace extended, okay AND-ed
+    buffer.add(ids[-1], [make_span(ids[-1], mint_id(), None, "element:A",
+                                   "element", "q", "s", 0, time.time(),
+                                   2.0)], okay=False)
+    merged = buffer.get(ids[-1])
+    assert len(merged["spans"]) == 2 and merged["okay"] is False
+    assert [t["trace_id"] for t in buffer.recent(2)][-1] == ids[-1]
+
+
+def test_span_wire_codec_roundtrip():
+    spans = [make_span("t" * 16, "s" * 16, None, "element:A", "element",
+                       "p", "0", 7, 123.456, 1.25)]
+    assert decode_spans(encode_spans(spans)) == spans
+    assert decode_spans("not base64 json!") == []
+
+
+# -- pipeline integration ---------------------------------------------------
+
+def test_pipeline_telemetry_rollup_and_share(runtime):
+    pipeline = simple_pipeline(
+        runtime, parameters={"telemetry_interval": 0.0})
+    pump(runtime, pipeline, 6)
+    rollup = pipeline.telemetry.rollup()
+    assert rollup["frame"]["count"] == 6
+    assert rollup["frame"]["p50_ms"] > 0.0
+    for name in ("A", "B"):
+        entry = rollup["element"][name]
+        assert entry["count"] == 6 and entry["p99_ms"] > 0.0
+    assert rollup["counters"]["frames_total.ok"] == 6
+    assert rollup["traces"]["completed"] == 6
+    # published on the share dict for ECConsumer/Dashboard
+    shared = pipeline.share["telemetry"]
+    assert shared["frame"]["count"] >= 1
+    assert "A" in shared["element"]
+    pipeline.stop()
+
+
+def test_metrics_text_nonzero_quantiles(runtime):
+    pipeline = simple_pipeline(runtime)
+    pump(runtime, pipeline, 5)
+    text = pipeline.metrics_text()
+    for name in ("A", "B"):
+        for q in ("0.5", "0.99"):
+            line = next(line for line in text.splitlines()
+                        if line.startswith(
+                            f'aiko_element_latency_ms{{element="{name}"'
+                            f',quantile="{q}"}}'))
+            assert float(line.split()[-1]) > 0.0
+    assert "aiko_frames_processed 5" in text
+    assert "aiko_traces_completed 5" in text
+    pipeline.stop()
+
+
+def test_telemetry_off_parameter(runtime):
+    pipeline = simple_pipeline(runtime, name="p_off",
+                               parameters={"telemetry": "off"})
+    rows = pump(runtime, pipeline, 2)
+    assert pipeline.telemetry is None
+    assert pipeline.metrics_text() == ""
+    assert pipeline.get_trace("anything") is None
+    assert "telemetry" not in pipeline.share
+    assert rows[0][4]                      # frames still flow
+    pipeline.stop()
+
+
+def test_frame_error_counted_and_traced(runtime):
+    definition = {"version": 0, "name": "p_err", "runtime": "jax",
+                  "graph": ["(A (B))"],
+                  "elements": [element("A", "Increment"),
+                               element("B", "Raiser",
+                                       module="tests/pipeline_elements.py")]}
+    definition["elements"][1]["input"] = [{"name": "x"}]
+    pipeline = Pipeline(definition, runtime=runtime)
+    responses = queue.Queue()
+    pipeline.process_frame_local({"x": 1, "a": 1}, stream_id="s",
+                                 queue_response=responses)
+    assert run_until(runtime, lambda: not responses.empty())
+    *_, okay, diagnostic = responses.get()
+    assert not okay
+    rollup = pipeline.telemetry.rollup()
+    assert rollup["counters"]["frames_total.error"] == 1
+    trace = pipeline.telemetry.traces.recent(1)[0]
+    assert trace["okay"] is False
+    root = next(s for s in trace["spans"] if s["kind"] == "frame")
+    assert root["status"] == "error"
+    pipeline.stop()
+
+
+def test_metrics_snapshot_not_live_dict(runtime):
+    """Responses must carry a SNAPSHOT of frame.metrics: consumers read
+    from foreign threads and must never share the loop-confined live
+    mapping."""
+    pipeline = simple_pipeline(runtime, name="p_snap")
+    stream = pipeline.create_stream_local("s")
+    captured = {}
+    original_respond = pipeline._respond
+
+    def spy(stream, frame, okay, diagnostic=""):
+        captured["frame"] = frame
+        return original_respond(stream, frame, okay, diagnostic)
+
+    pipeline._respond = spy
+    rows = pump(runtime, pipeline, 1)
+    returned_metrics = rows[0][3]
+    assert returned_metrics is not captured["frame"].metrics
+    assert returned_metrics == dict(captured["frame"].metrics)
+    pipeline.stop()
+
+
+def test_concurrent_metrics_scrape_under_load(runtime):
+    """The export surface is read from foreign threads (HTTP) while the
+    loop processes frames: no exception, and quantiles stay parseable."""
+    pipeline = simple_pipeline(runtime, name="p_conc")
+    errors = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                text = pipeline.metrics_text()
+                assert text.startswith("#") or text == ""
+                pipeline.telemetry.traces.recent(5)
+            except Exception as error:      # pragma: no cover
+                errors.append(error)
+                return
+
+    thread = threading.Thread(target=scraper)
+    thread.start()
+    try:
+        for _ in range(4):
+            pump(runtime, pipeline, 4)
+    finally:
+        stop.set()
+        thread.join(timeout=5.0)
+    assert not errors
+    pipeline.stop()
+
+
+def test_stream_destroy_purges_telemetry_state(runtime):
+    """A destroyed stream's open/pending span state must not survive
+    into a recreated same-id stream (frame ids restart per stream, so
+    stale keys would graft dead spans onto fresh traces)."""
+    definition = {"version": 0, "name": "p_purge", "runtime": "jax",
+                  "graph": ["(A (S))"],
+                  "elements": [element("A", "Increment"),
+                               element("S", "SlowAsync",
+                                       module="tests/test_stages.py")]}
+    pipeline = Pipeline(definition, runtime=runtime)
+    pipeline.create_stream_local("s")
+    pipeline.ingest_local("s", {"x": 0})
+    stream = pipeline.streams["s"]
+    assert run_until(
+        runtime,
+        lambda: any(frame.paused_pe_name == "S"
+                    for frame in stream.frames.values()),
+        timeout=5.0)
+    # Hard destroy with the frame parked at the async stage: its open
+    # element span would otherwise linger under ("element","S","s",0).
+    pipeline._destroy_stream_now("s")
+    telemetry = pipeline.telemetry
+    assert not any(key[2] == "s" for key in telemetry._open)
+    assert not any(key[0] == "s" for key in telemetry._pending)
+    # Recreated same-id stream: frame 0 again -- its trace must be
+    # clean (no adopted stale spans, no "unclosed" ghosts).
+    rows = pump(runtime, pipeline, 1, stream_id="s")
+    assert rows[0][4]
+    trace = telemetry.traces.recent(1)[0]
+    assert all(span["trace_id"] == trace["trace_id"]
+               for span in trace["spans"])
+    assert all(span["status"] == "ok" for span in trace["spans"])
+    pipeline.stop()
+
+
+# -- HTTP export surface ----------------------------------------------------
+
+def test_metrics_http_endpoint(runtime):
+    pipeline = simple_pipeline(runtime, name="p_http")
+    pump(runtime, pipeline, 3)
+    server = MetricsServer(pipeline, port=0, host="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=5.0).read().decode()
+        assert "aiko_frame_latency_ms" in text
+        assert "aiko_frames_processed 3" in text
+        payload = json.loads(urllib.request.urlopen(
+            f"{base}/traces?n=2", timeout=5.0).read())
+        assert len(payload["traces"]) == 2
+        trace_id = payload["traces"][-1]["trace_id"]
+        one = json.loads(urllib.request.urlopen(
+            f"{base}/traces/{trace_id}", timeout=5.0).read())
+        assert one["trace_id"] == trace_id and one["spans"]
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5.0)
+        # n must be a positive integer: n=0 would slice [-0:] == all
+        for bad in ("0", "-1", "abc"):
+            with pytest.raises(urllib.error.HTTPError) as excinfo:
+                urllib.request.urlopen(f"{base}/traces?n={bad}",
+                                       timeout=5.0)
+            assert excinfo.value.code == 400
+    finally:
+        server.stop()
+        pipeline.stop()

@@ -61,6 +61,37 @@ def test_profile_trace_context_manager(runtime, tmp_path):
     assert profiler._pipelines == []
 
 
+def test_unwind_closes_nested_pairs_innermost_first():
+    """Regression (ISSUE 4 satellite): detach()/_unwind() must close
+    nested ``compile:``/``segment:`` pairs INNERMOST-first.  Raw
+    popitem() order scrambles when a re-entered key moved an outer
+    ``compile:`` span after its inner ``segment:`` span in insertion
+    order -- the outer annotation then exited first and corrupted the
+    xprof nesting."""
+    profiler = Profiler()
+    exits = []
+
+    class FakeAnnotation:
+        def __init__(self, name):
+            self.name = name
+
+        def __exit__(self, *args):
+            exits.append(self.name)
+
+    base = ("S", "stream", 0)
+    # Adversarial insertion order: the outer compile: span sits AFTER
+    # its inner segment: span (re-entry scramble), with an element span
+    # opened in between.
+    profiler._open[("segment",) + base] = FakeAnnotation("segment:S")
+    profiler._open[("E", "stream", 0)] = FakeAnnotation("element:E")
+    profiler._open[("compile",) + base] = FakeAnnotation("compile:S")
+    profiler._unwind()
+    assert not profiler._open
+    assert exits.index("segment:S") < exits.index("compile:S"), exits
+    # Non-compile spans still close in reverse insertion order.
+    assert exits[0] == "element:E"
+
+
 def test_dangling_annotation_unwound(runtime, tmp_path):
     """An element that raises must not leak its open span into later
     elements (the engine pairs the enter hook with an ERROR post on

@@ -1,0 +1,191 @@
+"""Distributed frame tracing across RemoteStage hops (ISSUE 4): the
+trace context survives park/forward/resume round trips (including the
+undiscovered-remote retry/backoff path), and a two-stage PLACED
+pipeline with a remote hop yields ONE reconstructed trace -- a single
+trace_id with spans from both processes -- while ``metrics_text()``
+exposes nonzero p50/p99 for every element and stage."""
+
+import queue
+
+from conftest import run_until
+
+from aiko_services_tpu.pipeline import Pipeline
+from aiko_services_tpu.services import Registrar
+
+COMMON = "aiko_services_tpu.elements.common"
+
+
+def element(name, cls, parameters=None, placement=None, module=COMMON):
+    definition = {"name": name, "input": [{"name": "x"}],
+                  "output": [{"name": "x"}],
+                  "deploy": {"local": {"module": module,
+                                       "class_name": cls}},
+                  "parameters": parameters or {}}
+    if placement:
+        definition["placement"] = placement
+    return definition
+
+
+def remote(name, target):
+    return {"name": name, "input": [{"name": "x"}],
+            "output": [{"name": "x"}],
+            "deploy": {"remote": {"name": target}}}
+
+
+def back_pipeline(runtime, name="back", cls="Increment"):
+    return Pipeline({"version": 0, "name": name, "runtime": "jax",
+                     "graph": ["(inc)"],
+                     "elements": [element("inc", cls)]},
+                    runtime=runtime)
+
+
+def await_discovery(runtime, front, stage_name, timeout=10.0):
+    stage = front.graph.get_node(stage_name).element
+    assert run_until(runtime,
+                     lambda: stage.remote_topic_path is not None,
+                     timeout=timeout)
+
+
+def test_trace_spans_both_processes(runtime):
+    """Round trip: origin's TraceBuffer holds one trace whose spans
+    cover both pipelines, parented under the hop span."""
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    back = back_pipeline(runtime)
+    front = Pipeline({"version": 0, "name": "front", "runtime": "jax",
+                      "graph": ["(inc (fwd))"],
+                      "elements": [element("inc", "Increment"),
+                                   remote("fwd", "back")]},
+                     runtime=runtime)
+    await_discovery(runtime, front, "fwd")
+    responses = queue.Queue()
+    front.process_frame_local({"x": 0}, stream_id="s",
+                              queue_response=responses)
+    assert run_until(runtime, lambda: not responses.empty(),
+                     timeout=10.0)
+    *_, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+
+    trace = front.telemetry.traces.recent(1)[0]
+    spans = trace["spans"]
+    assert {span["trace_id"] for span in spans} == {trace["trace_id"]}
+    assert {span["process"] for span in spans} == {"front", "back"}
+    names = {span["name"] for span in spans}
+    assert {"element:inc", "remote:fwd"} <= names
+    # The remote pipeline's root span is parented under the hop span.
+    hop = next(s for s in spans if s["name"] == "remote:fwd")
+    remote_root = next(s for s in spans if s["kind"] == "frame"
+                       and s["process"] == "back")
+    assert remote_root["parent_id"] == hop["span_id"]
+    # The remote pipeline's own buffer holds its local view of the
+    # SAME trace id.
+    assert back.telemetry.traces.get(trace["trace_id"]) is not None
+    front.stop()
+    back.stop()
+
+
+def test_trace_id_survives_remote_retry_backoff(runtime):
+    """A frame parked waiting for remote discovery retries with
+    exponential backoff (remote_stage_retries) -- and resumes with the
+    SAME trace_id, so the slow discovery is one long trace, not a
+    broken one."""
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    front = Pipeline({"version": 0, "name": "front", "runtime": "jax",
+                      "graph": ["(inc (fwd))"],
+                      "elements": [element("inc", "Increment"),
+                                   remote("fwd", "back")]},
+                     runtime=runtime)
+    responses = queue.Queue()
+    front.create_stream_local("s", queue_response=responses)
+    front.ingest_local("s", {"x": 0}, queue_response=responses)
+    runtime.run(timeout=0.7)               # several backoff cycles
+    frame = front.streams["s"].frames[0]
+    minted = frame.trace_id
+    assert minted is not None
+    assert frame.remote_retries > 0
+    assert front.share["remote_stage_retries"] > 0
+
+    back = back_pipeline(runtime)          # NOW the remote appears
+    assert run_until(runtime, lambda: not responses.empty(),
+                     timeout=10.0)
+    *_, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    trace = front.telemetry.traces.get(minted)
+    assert trace is not None, "trace_id changed across retries"
+    assert {span["process"] for span in trace["spans"]} == \
+        {"front", "back"}
+    # The retry count also reached the telemetry counters.
+    assert front.telemetry.rollup()["counters"][
+        "remote_stage_retries"] >= frame.remote_retries
+    front.stop()
+    back.stop()
+
+
+def test_placed_two_stage_remote_hop_acceptance(runtime):
+    """ISSUE 4 acceptance: a two-stage PLACED pipeline with a
+    RemoteStage hop yields a single reconstructed trace (one trace_id,
+    >= 4 spans spanning both processes) from the TraceBuffer, and
+    metrics_text() exposes nonzero p50/p99 latency for every
+    element/stage under sustained frames."""
+    import jax
+
+    assert len(jax.devices()) >= 2
+    n = len(jax.devices())
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    back = back_pipeline(runtime, cls="Identity")  # array-safe remote
+    front = Pipeline({
+        "version": 0, "name": "front", "runtime": "jax",
+        "graph": ["(detect (llm (fwd)))"],
+        "parameters": {"telemetry_interval": 0.0},
+        "elements": [
+            element("detect", "StageWork", {"busy_ms": 2.0,
+                                            "factor": 2.0},
+                    {"devices": n // 2}),
+            element("llm", "StageWork", {"busy_ms": 3.0, "factor": 3.0},
+                    {"devices": n - n // 2}),
+            remote("fwd", "back"),
+        ]}, runtime=runtime)
+    assert front.stage_scheduler is not None     # stage-parallel active
+    await_discovery(runtime, front, "fwd")
+
+    import numpy as np
+    frames = 10
+    responses = queue.Queue()
+    x = np.ones((16, 16), dtype=np.float32)
+    for _ in range(frames):
+        front.process_frame_local({"x": x}, stream_id="s",
+                                  queue_response=responses)
+    assert run_until(runtime, lambda: responses.qsize() >= frames,
+                     timeout=60.0)
+    rows = [responses.get() for _ in range(frames)]
+    assert all(row[4] for row in rows), rows[0][5]
+
+    # -- one reconstructed trace, >= 4 spans, both processes ---------------
+    trace = front.telemetry.traces.recent(1)[0]
+    spans = trace["spans"]
+    assert len(spans) >= 4
+    assert {span["trace_id"] for span in spans} == {trace["trace_id"]}
+    assert {span["process"] for span in spans} == {"front", "back"}
+    kinds = {span["kind"] for span in spans}
+    assert {"element", "stage", "remote", "frame"} <= kinds
+
+    # -- nonzero p50/p99 for every element and stage -----------------------
+    text = front.metrics_text()
+    lines = text.splitlines()
+    for label, names in (("element", ("detect", "llm")),
+                         ("stage", ("detect", "llm"))):
+        series = "element_latency_ms" if label == "element" \
+            else "stage_latency_ms"
+        for name in names:
+            for q in ("0.5", "0.99"):
+                prefix = (f'aiko_{series}{{{label}="{name}"'
+                          f',quantile="{q}"}}')
+                line = next((l for l in lines if l.startswith(prefix)),
+                            None)
+                assert line is not None, f"missing {prefix}"
+                assert float(line.split()[-1]) > 0.0, line
+    # remote element's quantiles live in the BACK pipeline's exposition
+    back_text = back.metrics_text()
+    assert 'aiko_element_latency_ms{element="inc",quantile="0.99"}' in \
+        back_text
+    front.stop()
+    back.stop()
