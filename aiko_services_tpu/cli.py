@@ -137,17 +137,36 @@ def pipeline():
 @click.option("--metrics-host", default="127.0.0.1",
               help="bind address for --metrics-port (default loopback; "
                    "0.0.0.0 opts into remote scraping)")
+@click.option("--fault-plan", "fault_plan", default=None,
+              help="arm a chaos FaultPlan at startup: inline JSON or "
+                   "@path/to/plan.json (see README 'Failure model'); "
+                   "arm/disarm a RUNNING pipeline with "
+                   "'pipeline update NAME -p fault_plan <json|off>'")
 def pipeline_create(definition_pathname, transport, name, stream_id,
                     frame_data, parameters, frame_rate, profile_dir,
-                    hooks_spec, metrics_port, metrics_host):
+                    hooks_spec, metrics_port, metrics_host, fault_plan):
     """Create a Pipeline from DEFINITION_PATHNAME (JSON) and run it."""
     from .pipeline import create_pipeline
     from .utils import parse_value
 
     hook_names = _parse_hooks_spec(hooks_spec)   # fail before building
+    if fault_plan and fault_plan.startswith("@"):
+        try:
+            with open(fault_plan[1:]) as fh:
+                fault_plan = fh.read()
+        except OSError as error:
+            raise click.BadParameter(f"--fault-plan: {error}")
+    if fault_plan:
+        from .faults import FaultPlan
+        try:                                     # fail before building
+            FaultPlan.parse(fault_plan)
+        except (ValueError, TypeError) as error:
+            raise click.BadParameter(f"--fault-plan: {error}")
     runtime = _runtime(transport)
     instance = create_pipeline(definition_pathname, name=name,
                                runtime=runtime)
+    if fault_plan:
+        instance.arm_faults(fault_plan)
     if hook_names:
         from .runtime.hooks import default_hook_handler
 

@@ -327,6 +327,15 @@ class PipelineTelemetry:
         ledger = pipeline.transfer_ledger
         registry.gauge("swag_host_transfers", ledger.implicit)
         registry.gauge("swag_explicit_fetches", ledger.explicit)
+        # Failure-recovery plane (ISSUE 5): replay/shed/deadline
+        # counters and per-remote-stage breaker state (0 closed,
+        # 0.5 half-open, 1 open) -- the scrape-side proof that recovery
+        # ran, mirroring the chaos suite's assertions.
+        for key in ("frames_replayed", "frames_shed", "deadline_misses"):
+            registry.gauge(key, pipeline.share.get(key, 0))
+        for stage, breaker in getattr(pipeline, "breakers", {}).items():
+            registry.gauge("breaker_state", breaker.state_value,
+                           stage=stage)
         try:
             jit = pipeline.jit_stats()
             for key in ("hits", "misses", "entries"):

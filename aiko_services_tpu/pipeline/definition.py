@@ -37,6 +37,10 @@ class ElementDefinition:
     deploy_remote: dict | None = None     # ServiceFilter fields
     parameters: dict = field(default_factory=dict)
     placement: dict = field(default_factory=dict)
+    # Degraded-mode failover (ISSUE 5): the name of another (locally
+    # deployed, off-graph) element definition to run in place of this
+    # remote stage while its circuit breaker is open.
+    fallback: str | None = None
 
     @property
     def input_names(self) -> list[str]:
@@ -137,6 +141,15 @@ def parse_pipeline_definition(data: dict | str,
                 f"'remote' (service filter)")
         if deploy_local is not None:
             _require(deploy_local, "module", str, f"{path}.deploy.local")
+        fallback = entry.get("fallback")
+        if fallback is not None:
+            if not isinstance(fallback, str):
+                raise DefinitionError(f"{path}.fallback: expected an "
+                                      f"element name string")
+            if deploy_remote is None:
+                raise DefinitionError(
+                    f"{path}.fallback: only remote-deployed elements "
+                    f"may declare a fallback")
         elements.append(ElementDefinition(
             name=element_name,
             input=_parse_io(entry.get("input", []), f"{path}.input"),
@@ -144,7 +157,22 @@ def parse_pipeline_definition(data: dict | str,
             deploy_local=deploy_local,
             deploy_remote=deploy_remote,
             parameters=entry.get("parameters", {}),
-            placement=entry.get("placement", {})))
+            placement=entry.get("placement", {}),
+            fallback=fallback))
+
+    names = {element.name for element in elements}
+    for element in elements:
+        if element.fallback is None:
+            continue
+        if element.fallback not in names:
+            raise DefinitionError(
+                f"{source}: element {element.name!r} fallback "
+                f"{element.fallback!r} is not a defined element")
+        target = next(e for e in elements if e.name == element.fallback)
+        if target.deploy_local is None:
+            raise DefinitionError(
+                f"{source}: fallback {element.fallback!r} must be "
+                f"locally deployed (it runs when the remote is down)")
 
     return PipelineDefinition(name=name, version=version, runtime=runtime,
                               graph=list(graph), parameters=parameters,

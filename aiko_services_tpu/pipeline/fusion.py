@@ -424,6 +424,13 @@ class FusedSegment:
         return self.jit_cache.probe(self._traced_fn,
                                     (keep, donate, self._captures))
 
+    def poison(self, reason: str) -> None:
+        """Mark this segment broken: the cached plan splices its members
+        back in on the next walk and every later frame runs per-element
+        (trace/compile failure, injected segment fault)."""
+        self.broken = True
+        _logger.warning("segment %s poisoned: %s", self.name, reason)
+
     def call(self, resolved: dict, donated: set) -> dict:
         """ONE device dispatch for the whole segment.  Returns the trace
         outputs dict keyed ``element.name``."""

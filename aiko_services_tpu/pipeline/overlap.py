@@ -50,7 +50,7 @@ import jax
 import numpy as np
 
 __all__ = ["TransferLedger", "DeviceWindow", "device_leaves",
-           "DEVICE_INFLIGHT_DEFAULT"]
+           "touches_devices", "DEVICE_INFLIGHT_DEFAULT"]
 
 TRANSFER_POLICIES = ("allow", "log", "disallow")
 
@@ -64,6 +64,21 @@ def device_leaves(tree) -> list:
     """Every ``jax.Array`` leaf of a swag/pytree (host values skipped)."""
     return [leaf for leaf in jax.tree_util.tree_leaves(tree)
             if isinstance(leaf, jax.Array)]
+
+
+def touches_devices(tree, devices: set) -> bool:
+    """True when any device leaf of ``tree`` lives (even partly) on one
+    of ``devices`` -- the replay path's test for swag values stranded on
+    dead chips.  A leaf whose device set cannot be read (deleted buffer,
+    backend drift) counts as touching: recovery must treat it as
+    compromised, not silently keep it."""
+    for leaf in device_leaves(tree):
+        try:
+            if set(leaf.devices()) & devices:
+                return True
+        except Exception:
+            return True
+    return False
 
 
 class TransferLedger:
@@ -203,6 +218,22 @@ class DeviceWindow:
     def clear(self) -> None:
         """Drop bookkeeping without blocking (stream destroy)."""
         self._inflight.clear()
+
+    def invalidate(self, failed: set) -> int:
+        """Forget noted frames whose outstanding leaves sit on dead
+        chips (device replacement): ``pace`` would otherwise
+        ``block_until_ready`` a buffer whose device no longer exists --
+        a raise at best, a hang at worst.  Returns how many noted
+        frames were dropped."""
+        keep, dropped = [], 0
+        for frame_id, leaves in self._inflight:
+            if touches_devices(leaves, failed):
+                dropped += 1
+            else:
+                keep.append((frame_id, leaves))
+        if dropped:
+            self._inflight = deque(keep)
+        return dropped
 
     @property
     def outstanding(self) -> int:

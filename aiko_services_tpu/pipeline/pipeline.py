@@ -33,7 +33,8 @@ from .definition import (PipelineDefinition, parse_pipeline_definition,
 from .element import ElementContext, PipelineElement, PipelineElementLoop
 from .fusion import (FUSE_MODES, FusedSegment, partition,
                      setup_compilation_cache)
-from .overlap import DEVICE_INFLIGHT_DEFAULT, TransferLedger
+from .overlap import (DEVICE_INFLIGHT_DEFAULT, TransferLedger,
+                      touches_devices)
 from .stages import (STAGE_INFLIGHT_DEFAULT, STAGE_PIPELINE_MODES,
                      StageScheduler)
 from .stream import (Stream, Frame, StreamEvent, StreamState,
@@ -43,6 +44,8 @@ from ..observability import (HISTOGRAM_WINDOW_DEFAULT,
                              TRACE_CAPACITY_DEFAULT, PipelineTelemetry,
                              decode_spans, encode_spans, make_span,
                              mint_id)
+from ..faults import (CircuitBreaker, FaultInjected, FaultPlan,
+                      wire_fault_filter)
 from ..runtime import Lease
 from ..services import Actor, ServiceFilter, get_service_proxy, do_discovery
 from ..services.service import SERVICE_PROTOCOL_PREFIX
@@ -63,9 +66,24 @@ _GRACE_TIME_DEFAULT = 120.0
 _STALL_REAP_FACTOR = 10
 _METRICS_MEMORY = False           # RSS deltas per element when True
 # Undiscovered remote stages: retry with exponential backoff from the
-# base up to the cap (a fixed 0.25 s forever was a silent hot loop).
+# base up to the cap (a fixed 0.25 s forever was a silent hot loop),
+# bounded by the ``remote_retry_limit`` pipeline/stream parameter
+# (0 = retry forever, the pre-ISSUE-5 behavior).
 _REMOTE_RETRY_BASE = 0.25
 _REMOTE_RETRY_CAP = 2.0
+REMOTE_RETRY_LIMIT_DEFAULT = 8
+# Failure recovery (ISSUE 5): how many times one frame may be replayed
+# across device replacements before it errors (``replay_limit``
+# parameter, 0 = unbounded), the per-remote-stage circuit breaker
+# defaults (``breaker_threshold`` consecutive failures open it,
+# 0 disables; ``breaker_cooldown_ms`` before a half-open probe), and
+# the live-stream overload bound (``overload_policy`` block|shed_oldest
+# |shed_newest with ``overload_limit`` in-flight frames).
+REPLAY_LIMIT_DEFAULT = 2
+BREAKER_THRESHOLD_DEFAULT = 3
+BREAKER_COOLDOWN_MS_DEFAULT = 1000.0
+OVERLOAD_POLICIES = ("block", "shed_oldest", "shed_newest")
+OVERLOAD_LIMIT_DEFAULT = 8
 
 # Stage-worker threads (pipeline/stages.py) run elements off the event
 # loop; ``get_parameter`` resolution reaches the owning stream through
@@ -136,6 +154,21 @@ class Pipeline(Actor):
         self._frames_processed = 0
         self._remote_retries = 0
         self.share["remote_stage_retries"] = 0
+        # Failure recovery (ISSUE 5): fault-injection plan (None =
+        # unarmed, zero hot-path work), per-remote-stage circuit
+        # breakers, lazily built fallback elements, and the recovery
+        # counters the chaos suite asserts on.
+        self._faults: FaultPlan | None = None
+        self._wire_faults_installed = False
+        self.breakers: dict[str, CircuitBreaker] = {}
+        self._fallback_elements: dict[str, PipelineElement] = {}
+        self._frames_replayed = 0
+        self._frames_shed = 0
+        self._deadline_misses = 0
+        self.share["frames_replayed"] = 0
+        self.share["frames_shed"] = 0
+        self.share["deadline_misses"] = 0
+        self.share["faults_armed"] = False
 
         self.add_hook("pipeline.process_frame:0")
         self.add_hook("pipeline.process_element:0")
@@ -173,6 +206,10 @@ class Pipeline(Actor):
         if interval and self.stage_placement is not None:
             self._health_timer = self.runtime.engine.add_timer_handler(
                 self.check_device_health, float(interval))
+
+        fault_plan = definition.parameters.get("fault_plan")
+        if fault_plan:
+            self.arm_faults(fault_plan)
 
     # -- graph construction ------------------------------------------------
 
@@ -248,16 +285,28 @@ class Pipeline(Actor):
             self.runtime.engine.remove_timer_handler(self._health_timer)
             self._health_timer = None
 
-    def check_device_health(self, prober=None) -> list:
+    def check_device_health(self, prober=None, timeout=None) -> list:
         """Probe the placement's devices; on failure, re-place stages on
         the survivors (SURVEY.md §5.3 TPU-equiv: chip health checks +
         stage re-placement).  Returns the failed devices (empty when all
         healthy or no placement).  Schedule periodically via the
-        ``health_check_interval`` pipeline parameter (seconds)."""
+        ``health_check_interval`` pipeline parameter (seconds); probe
+        deadline from ``timeout`` or the ``health_probe_timeout``
+        pipeline parameter (seconds, default tpu/health.PROBE_TIMEOUT).
+
+        An armed FaultPlan's ``device_kill``/``device_hang`` rules wrap
+        the prober here -- the swappable-prober injection point, so
+        chaos exercises the genuine probe -> replace -> replay path."""
         if self.stage_placement is None:
             return []
         from ..tpu.health import probe_devices
-        failed = probe_devices(self.stage_placement.devices, prober)
+        if timeout is None:
+            timeout = parse_number(
+                self.get_pipeline_parameter("health_probe_timeout"), None)
+        if self._faults is not None:
+            prober = self._fault_prober(prober)
+        failed = probe_devices(self.stage_placement.devices, prober,
+                               timeout=timeout)
         if failed:
             self.replace_failed_devices(failed)
         return failed
@@ -301,9 +350,26 @@ class Pipeline(Actor):
             stream.fusion_plans.clear()
             stream.fusion_segments.clear()
         self.fused_segments.clear()
+        # In-flight recovery (ISSUE 5): frames alive right now were
+        # dispatched against the dead submeshes.  Their outstanding
+        # dispatch-window leaves must never be block_until_ready'd, and
+        # the frames themselves replay from their last host-visible
+        # boundary instead of erroring the stream.
+        failed_set = set(failed_devices)
+        replay_limit = int(parse_number(
+            self.get_pipeline_parameter("replay_limit"),
+            REPLAY_LIMIT_DEFAULT))
+        replayed = 0
+        for stream in list(self.streams.values()):
+            stream.device_window.invalidate(failed_set)
+            for frame in list(stream.frames.values()):
+                if self._replay_frame(stream, frame, failed_set,
+                                      replay_limit):
+                    replayed += 1
         self.run_hook("pipeline.replacement:0",
                       lambda: {"failed": [str(d) for d in failed_devices],
                                "generation": placement.generation,
+                               "replayed": replayed,
                                "stages": {name: dict(plan.mesh.shape)
                                           for name, plan
                                           in placement.plans.items()}})
@@ -363,6 +429,15 @@ class Pipeline(Actor):
         if name is None:
             return
         name = str(name)
+        if name == "fault_plan":
+            # Live chaos trigger: ``-p fault_plan <json>`` from the CLI
+            # / dashboard arms (or, with an empty value, disarms) the
+            # fault harness on a running pipeline.
+            if value in (None, "", "off", "disarm"):
+                self.disarm_faults()
+            else:
+                self.arm_faults(value)
+            return
         element_name, _, bare = name.partition(".")
         if bare and element_name in self.graph:
             self.graph.get_node(element_name).element.set_parameter(
@@ -443,6 +518,424 @@ class Pipeline(Actor):
                 "dispatches": sum(s.calls for s in self.fused_segments),
                 "broken": sum(1 for s in self.fused_segments if s.broken)}
 
+    # -- fault harness + failure recovery (ISSUE 5) ------------------------
+
+    def arm_faults(self, spec=None) -> None:
+        """Arm a FaultPlan: ``spec`` is a rules list / {"seed", "rules"}
+        dict / JSON string (see faults/plan.py for the points).  Wire-
+        callable -- ``(arm_faults <json>)`` -- so the dashboard or CLI
+        triggers chaos against a LIVE pipeline.  Re-arming replaces the
+        previous plan; wire rules install a filter on the loopback
+        broker (the only transport that supports them)."""
+        try:
+            plan = FaultPlan.parse(spec)
+        except (ValueError, TypeError) as error:
+            self.logger.error("arm_faults: bad plan: %s", error)
+            return
+        self._remove_wire_faults()
+        self._faults = plan
+        self.logger.warning("fault plan ARMED: %d rule(s), seed=%d",
+                            len(plan.rules), plan.seed)
+        if plan.has_wire_rules:
+            broker = self._loopback_broker()
+            if broker is None:
+                self.logger.warning(
+                    "fault plan has wire rules but the transport is not "
+                    "loopback; wire faults will not fire")
+            else:
+                broker.set_fault_filter(
+                    wire_fault_filter(plan, broker.publish_direct))
+                self._wire_faults_installed = True
+        self.ec_producer.update("faults_armed", True)
+
+    def disarm_faults(self) -> None:
+        """Disarm the plan: every injection point returns to its
+        unarmed (zero-work) path."""
+        self._remove_wire_faults()
+        if self._faults is not None:
+            self.logger.warning("fault plan disarmed")
+        self._faults = None
+        self.ec_producer.update("faults_armed", False)
+
+    def _loopback_broker(self):
+        message = getattr(self.runtime, "message", None)
+        return getattr(message, "_broker", None)
+
+    def _remove_wire_faults(self) -> None:
+        if not self._wire_faults_installed:
+            return
+        broker = self._loopback_broker()
+        if broker is not None:
+            broker.set_fault_filter(None)
+        self._wire_faults_installed = False
+
+    def fault_stats(self) -> dict:
+        """The chaos/recovery surface tests and the dashboard read:
+        plan counters + trace (blast radius), breaker states, and the
+        recovery counters."""
+        stats = {"armed": self._faults is not None,
+                 "frames_replayed": self._frames_replayed,
+                 "frames_shed": self._frames_shed,
+                 "deadline_misses": self._deadline_misses,
+                 "breakers": {name: breaker.stats
+                              for name, breaker in self.breakers.items()}}
+        if self._faults is not None:
+            stats["plan"] = self._faults.stats
+        return stats
+
+    def _fault_target_devices(self, target) -> set:
+        """Resolve a device-fault rule's target: a placed stage name
+        (its current submesh), ``device:<index>`` into the placement
+        pool, or None for every placed device."""
+        placement = self.stage_placement
+        if placement is None:
+            return set()
+        if target is None:
+            return set(placement.devices)
+        target = str(target)
+        if target in placement.plans:
+            return placement.stage_devices(target)
+        if target.startswith("device:"):
+            try:
+                return {placement.devices[int(target[7:])]}
+            except (ValueError, IndexError):
+                return set()
+        return set()
+
+    def _fault_prober(self, prober):
+        """Wrap the health prober per the armed plan: ``device_kill``
+        targets report dead, ``device_hang`` targets sleep through the
+        probe deadline.  Rules fire ONCE per health check (count
+        semantics: one rule firing = one failure event)."""
+        plan = self._faults
+        dead: set = set()
+        hung: list = []
+        for rule in plan.fire_point("device_kill"):
+            dead |= self._fault_target_devices(rule.target)
+        for rule in plan.fire_point("device_hang"):
+            hung.append((self._fault_target_devices(rule.target),
+                         rule.delay_ms))
+        if not dead and not hung:
+            return prober
+        from ..tpu.health import default_prober
+        base = prober or default_prober
+        self.logger.warning("injected device fault: %d dead, %d hung",
+                            len(dead), len(hung))
+
+        def wrapped(device):
+            if device in dead:
+                return False
+            for devices, delay_ms in hung:
+                if device in devices:
+                    time.sleep(delay_ms / 1000.0)
+            return base(device)
+
+        return wrapped
+
+    def _inject_element_fault(self, node_name: str, stream_id) -> None:
+        """Armed-plan probe at an element dispatch site (sync walk,
+        stage worker, async submit).  ``element_hang`` sleeps in place
+        -- a chip gone quiet; ``element_raise`` raises FaultInjected --
+        the XLA dead-chip dispatch error surface.  Callers' existing
+        exception paths (and the dispatch-error recovery probe) handle
+        the rest, which is the point: chaos runs the REAL paths."""
+        faults = self._faults
+        if faults is None:          # disarmed between check and call
+            return
+        rule = faults.should("element_hang", target=node_name,
+                             stream=stream_id)
+        if rule is not None:
+            time.sleep(rule.delay_ms / 1000.0)
+        rule = faults.should("element_raise", target=node_name,
+                             stream=stream_id)
+        if rule is not None:
+            raise FaultInjected(
+                f"injected device failure at {node_name}")
+
+    def _inject_segment_fault(self, segment_name: str, stream_id) -> None:
+        """Armed-plan probe at a fused-segment dispatch site (event
+        loop and stage-worker paths share it)."""
+        faults = self._faults
+        if faults is not None \
+                and faults.should("segment_fail", target=segment_name,
+                                  stream=stream_id) is not None:
+            raise FaultInjected(
+                f"injected segment failure at {segment_name}")
+
+    def _recover_after_dispatch_error(self, stream: Stream,
+                                      frame: Frame) -> bool:
+        """A dispatch raised on a placed pipeline: before declaring the
+        frame dead, probe the chips -- on real hardware XLA raising at
+        dispatch IS how chip loss presents.  When the probe finds
+        failures, ``replace_failed_devices`` has already re-placed the
+        stages and replayed (or error-bounded) every in-flight frame,
+        THIS one included; the caller must then skip its own
+        _frame_error.  Healthy probe -> False -> normal error path (a
+        code bug is not a chip loss)."""
+        if self.stage_placement is None:
+            return False
+        try:
+            failed = self.check_device_health()
+        except Exception:
+            self.logger.exception("post-dispatch-error health check "
+                                  "failed")
+            return False
+        return bool(failed)
+
+    def _replay_frame(self, stream: Stream, frame: Frame, failed: set,
+                      replay_limit: int) -> bool:
+        """Re-admit one in-flight frame after a device replacement.
+
+        The replay frontier is the frame's last host-visible boundary:
+        elements whose outputs the frame already accepted
+        (``frame.completed``) never re-execute; swag device leaves on
+        dead chips are fetched to host when still reachable (re-uploaded
+        to the replacement submeshes by the replayed walk's normal
+        hops/puts) or dropped.  Bounded by ``replay_limit`` per frame;
+        over it, the frame errors instead of looping.  Returns True when
+        the frame was scheduled for replay."""
+        node = self.graph.get_node(frame.paused_pe_name) \
+            if frame.paused_pe_name is not None \
+            and frame.paused_pe_name in self.graph else None
+        if node is not None and isinstance(node.element, RemoteStage):
+            # The remote round trip is unaffected by LOCAL chip death;
+            # just scrub stranded swag so the resume survives.
+            self._scrub_swag(frame, failed)
+            return False
+        frame.replays += 1
+        if replay_limit and frame.replays > replay_limit:
+            # Per-frame failure: the over-budget FRAME errors; sibling
+            # frames still within budget keep their replays (and the
+            # stream) alive.
+            self._frame_fail(
+                stream, frame,
+                f"replay limit ({replay_limit}) exceeded after device "
+                f"replacement")
+            return False
+        # Stale-ify every in-flight continuation of the PREVIOUS
+        # attempt: worker/async completion posts carry the epoch they
+        # were submitted under and are discarded on mismatch.
+        frame.replay_epoch += 1
+        frame.paused_pe_name = None
+        self._release_stage(stream, frame)
+        self._scrub_swag(frame, failed)
+        resume_at = None
+        for path_node in self._stream_path(stream):
+            if path_node.name not in frame.completed:
+                resume_at = path_node.name
+                break
+        self._count_replay(stream)
+        frame.metrics["replays"] = frame.replays
+        self.logger.warning(
+            "stream %s frame %s: replaying at %s (attempt %d) after "
+            "device replacement", stream.stream_id, frame.frame_id,
+            resume_at, frame.replays)
+        if resume_at is None:
+            self._frame_done(stream, frame, None)
+            return True
+        self.post_self("retry_frame_at",
+                       [stream.stream_id, frame, resume_at])
+        return True
+
+    def _scrub_swag(self, frame: Frame, failed: set) -> None:
+        """Invalidate swag device leaves stranded on dead chips: values
+        still fetchable come back as host copies (ONE counted ledger
+        fetch each -- the engine-initiated sanctioned transfer), values
+        whose buffers died with the chip are dropped so the replayed
+        walk fails cleanly on missing inputs rather than dispatching a
+        dead buffer."""
+        dropped = 0
+        for key in list(frame.swag):
+            value = frame.swag[key]
+            if not touches_devices(value, failed):
+                continue
+            try:
+                frame.swag[key] = self.transfer_ledger.fetch(value)
+            except Exception:
+                frame.swag.pop(key, None)
+                dropped += 1
+        if dropped:
+            frame.metrics["replay_dropped_keys"] = \
+                frame.metrics.get("replay_dropped_keys", 0) + dropped
+
+    # -- deadlines + overload shedding -------------------------------------
+
+    def _count_replay(self, stream: Stream) -> None:
+        self._frames_replayed += 1
+        self.share["frames_replayed"] = self._frames_replayed
+        if self.telemetry is not None:
+            self.telemetry.registry.count("frames_replayed")
+
+    def _count_shed(self, stream: Stream) -> None:
+        self._frames_shed += 1
+        self.share["frames_shed"] = self._frames_shed
+        if self.telemetry is not None:
+            self.telemetry.registry.count("frames_shed")
+
+    def _deadline_fail(self, stream: Stream, frame: Frame) -> None:
+        """A frame blew its ``frame_deadline_ms``: cancel remaining
+        work (the frame leaves stream.frames, so any in-flight
+        continuation post goes stale) and deliver a deadline error in
+        its reorder slot.  The STREAM stays alive -- an SLO miss on one
+        frame is not a stream failure.  A frame parked at a remote
+        stage counts the miss against that stage's circuit breaker:
+        the remote never answered in time."""
+        self._deadline_misses += 1
+        self.share["deadline_misses"] = self._deadline_misses
+        if self.telemetry is not None:
+            self.telemetry.registry.count("deadline_misses")
+        parked_at = frame.paused_pe_name
+        if parked_at is not None and parked_at in self.graph:
+            node = self.graph.get_node(parked_at)
+            if isinstance(node.element, RemoteStage):
+                breaker = self._stage_breaker(parked_at)
+                if breaker is not None:
+                    breaker.record_failure()
+        frame.metrics["deadline_missed"] = True
+        frame.replay_epoch += 1         # stale-ify late worker posts
+        self._frame_fail(stream, frame,
+                         f"deadline exceeded "
+                         f"({stream.deadline_ms:.0f} ms)")
+
+    def expire_frame(self, stream_id, frame_id, frame_ref=None):
+        """Continuation posted at ingest for deadline-bearing frames:
+        fires once at the deadline and fails the frame wherever it is
+        -- walking, queued for admission, or parked at an async/worker/
+        remote stage that will never answer.  This is what guarantees
+        'completes or errors within its deadline' even for parks."""
+        stream = self.streams.get(str(stream_id))
+        frame = stream.frames.get(int(frame_id)) \
+            if stream is not None else None
+        if frame is None or frame is not frame_ref \
+                or frame.deadline is None:
+            return
+        remaining = frame.deadline - time.monotonic()
+        if remaining > 0:               # timer fired marginally early
+            self.post_self("expire_frame",
+                           [stream_id, frame_id, frame],
+                           delay=remaining + 0.005)
+            return
+        self._deadline_fail(stream, frame)
+
+    def _shed_for_overload(self, stream: Stream) -> bool:
+        """Queue-depth shedding at ingest for live streams.  Returns
+        True when the INCOMING frame should be refused (shed_newest, or
+        shed_oldest with no cancellable victim); shed_oldest cancels
+        the oldest frame still waiting for stage admission -- the only
+        frames whose work can be cancelled without abandoning running
+        compute -- which also frees its credit-window pressure."""
+        if stream.overload_policy == "block" or not stream.overload_limit \
+                or stream.in_flight < stream.overload_limit:
+            return False
+        if stream.overload_policy == "shed_oldest":
+            victim = min(
+                (f for f in stream.frames.values()
+                 if f.stage_waiting is not None),
+                key=lambda f: f.frame_id, default=None)
+            if victim is not None:
+                self._count_shed(stream)
+                victim.metrics["shed"] = True
+                self._frame_fail(
+                    stream, victim,
+                    f"shed: overload ({stream.overload_policy}, "
+                    f"{stream.in_flight} in flight)")
+                return False
+        return True
+
+    def _shed_incoming(self, stream: Stream, frame: Frame) -> None:
+        """Refuse an incoming frame under overload: it still takes its
+        delivery slot (in-order contract) and responds with a shed
+        error immediately."""
+        self._count_shed(stream)
+        frame.metrics["shed"] = True
+        self._frame_fail(stream, frame,
+                         f"shed: overload ({stream.overload_policy}, "
+                         f"{stream.in_flight} in flight)")
+
+    def _stamp_deadline(self, stream: Stream, frame: Frame) -> None:
+        if not stream.deadline_ms:
+            return
+        frame.deadline = time.monotonic() + stream.deadline_ms / 1000.0
+        self.post_self("expire_frame",
+                       [stream.stream_id, frame.frame_id, frame],
+                       delay=stream.deadline_ms / 1000.0 + 0.002)
+
+    def _past_deadline(self, frame: Frame) -> bool:
+        return frame.deadline is not None \
+            and time.monotonic() > frame.deadline
+
+    # -- remote-stage circuit breaker --------------------------------------
+
+    def _stage_breaker(self, node_name: str) -> CircuitBreaker | None:
+        """The per-remote-stage breaker (None when disabled via
+        ``breaker_threshold: 0``)."""
+        threshold = int(parse_number(
+            self.get_pipeline_parameter("breaker_threshold"),
+            BREAKER_THRESHOLD_DEFAULT))
+        if threshold <= 0:
+            return None
+        breaker = self.breakers.get(node_name)
+        if breaker is None:
+            cooldown = float(parse_number(
+                self.get_pipeline_parameter("breaker_cooldown_ms"),
+                BREAKER_COOLDOWN_MS_DEFAULT)) / 1000.0
+            breaker = self.breakers[node_name] = CircuitBreaker(
+                threshold, cooldown)
+        return breaker
+
+    def _run_fallback(self, stream: Stream, frame: Frame, node):
+        """Run a remote stage's declared ``fallback:`` element locally
+        while the breaker is open (degraded mode).  Outputs map out
+        under the REMOTE node's name so downstream mappings hold.
+        Returns True (ran, keep walking), False (no fallback declared),
+        None (frame errored)."""
+        definition = node.element.definition
+        fallback_name = definition.fallback if definition else None
+        if not fallback_name:
+            return False
+        element = self._fallback_elements.get(node.name)
+        if element is None:
+            element_def = self.definition.element(fallback_name)
+            cls = self._load_element_class(element_def.deploy_local)
+            context = ElementContext(fallback_name, element_def, self,
+                                     dict(element_def.parameters))
+            element = self._fallback_elements[node.name] = cls(context)
+        inputs, missing, _ = self._map_in_for(element,
+                                              node.properties or {},
+                                              frame.swag)
+        if missing:
+            self._frame_error(stream, frame,
+                              f"{fallback_name} (fallback for "
+                              f"{node.name}): missing inputs {missing}")
+            return None
+        try:
+            result = element.process_frame(stream, **inputs)
+        except Exception as error:
+            self.logger.exception("fallback %s raised", fallback_name)
+            self._frame_error(stream, frame,
+                              f"{fallback_name} (fallback for "
+                              f"{node.name}): {error}")
+            return None
+        event, outputs = result if isinstance(result, tuple) \
+            else (result, {})
+        if event != StreamEvent.OKAY:
+            diagnostic = (outputs or {}).get("diagnostic", "") \
+                if isinstance(outputs, dict) else ""
+            self._frame_error(stream, frame,
+                              f"{fallback_name} (fallback for "
+                              f"{node.name}): {diagnostic or event}")
+            return None
+        self._map_out(node, frame, outputs or {})
+        frame.metrics["breaker_fallbacks"] = \
+            frame.metrics.get("breaker_fallbacks", 0) + 1
+        if self.telemetry is not None:
+            self.telemetry.registry.count("breaker_fallbacks",
+                                          stage=node.name)
+        self.logger.warning("stream %s frame %s: breaker open, ran "
+                            "fallback %s for %s", stream.stream_id,
+                            frame.frame_id, fallback_name, node.name)
+        return True
+
     def metrics_text(self) -> str:
         """Prometheus-style text exposition of the telemetry plane
         (histogram quantiles, counters, engine gauges).  Empty when
@@ -509,6 +1002,29 @@ class Pipeline(Actor):
                                 "using auto", stream_id, fuse, FUSE_MODES)
             fuse = "auto"
         stream.fuse = fuse
+        # Per-frame deadline + overload shedding (ISSUE 5), resolved
+        # once per stream: stream parameters win over pipeline
+        # parameters, like device_inflight above.
+        stream.deadline_ms = float(parse_number(
+            stream.parameters.get(
+                "frame_deadline_ms",
+                self._pipeline_parameters.get("frame_deadline_ms")),
+            0.0))
+        policy = str(stream.parameters.get(
+            "overload_policy",
+            self._pipeline_parameters.get("overload_policy",
+                                          "block"))).strip().lower()
+        if policy not in OVERLOAD_POLICIES:
+            self.logger.warning("stream %s: overload_policy=%r not one "
+                                "of %s; using block", stream_id, policy,
+                                OVERLOAD_POLICIES)
+            policy = "block"
+        stream.overload_policy = policy
+        stream.overload_limit = int(parse_number(
+            stream.parameters.get(
+                "overload_limit",
+                self._pipeline_parameters.get("overload_limit")),
+            OVERLOAD_LIMIT_DEFAULT))
         if grace_time:
             stream.lease = Lease(
                 self.runtime.engine, float(grace_time), stream_id,
@@ -670,8 +1186,13 @@ class Pipeline(Actor):
                       swag=dict(frame_data))
         if self.telemetry is not None:
             self.telemetry.frame_started(frame)
+        shed = self._shed_for_overload(stream)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
+        if shed:
+            self._shed_incoming(stream, frame)
+            return
+        self._stamp_deadline(stream, frame)
         # Bounded dispatch window: before this frame's device work
         # enqueues, sync the oldest completed-but-unsynced frame(s) so
         # dispatch stays at most device_inflight frames ahead.
@@ -707,8 +1228,13 @@ class Pipeline(Actor):
             # the stream's reorder buffer / admission window.
             self._release_stage(stream, stale)
             self._deliver(stream, stale, okay=False, skip=True)
+        shed = self._shed_for_overload(stream)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
+        if shed:
+            self._shed_incoming(stream, frame)
+            return
+        self._stamp_deadline(stream, frame)
         paced = stream.device_window.pace(stream.device_inflight)
         if paced and self.telemetry is not None:
             self.telemetry.registry.observe("ingest_pace_ms",
@@ -734,6 +1260,12 @@ class Pipeline(Actor):
             stream.frames.pop(frame.frame_id, None)
             self._release_stage(stream, frame)
             self._deliver(stream, frame, okay=False, skip=True)
+            return
+        if self._past_deadline(frame):
+            # Every walk entry and resume continuation passes through
+            # here, so this one check enforces the deadline at ingest,
+            # stage-hop and park-resume boundaries alike.
+            self._deadline_fail(stream, frame)
             return
         stream.last_frame_time = time.monotonic()   # grace lease clock
         self.run_hook("pipeline.process_frame:0",
@@ -816,6 +1348,26 @@ class Pipeline(Actor):
                     # the whole round trip -- a slow remote would wedge
                     # the window for every stream.
                     self._release_stage(stream, frame)
+                    breaker = self._stage_breaker(node.name)
+                    if breaker is not None and not breaker.allow():
+                        # Open breaker: don't touch the wire.  Run the
+                        # declared fallback element (degraded mode) or
+                        # fail the FRAME fast -- the stream stays
+                        # alive, and a later frame probes half-open.
+                        ran = self._run_fallback(stream, frame, node)
+                        if ran is None:
+                            return        # frame errored in fallback
+                        if ran:
+                            index += 1
+                            continue
+                        if self.telemetry is not None:
+                            self.telemetry.registry.count(
+                                "breaker_rejects", stage=node.name)
+                        self._frame_fail(
+                            stream, frame,
+                            f"remote stage {node.name}: circuit "
+                            f"breaker open")
+                        return
                     if self._forward_frame(stream, frame, node):
                         frame.remote_retries = 0
                         return            # frame parked at remote stage
@@ -825,8 +1377,26 @@ class Pipeline(Actor):
                     # STAYS in stream.frames so graceful destroy_stream
                     # counts it as in-flight.  Exponential backoff with
                     # a cap (a fixed short retry forever is a silent
-                    # hot loop) and a counted share metric so a missing
-                    # remote stage is VISIBLE.
+                    # hot loop), BOUNDED by ``remote_retry_limit``
+                    # (0 = forever) so a permanently missing remote
+                    # errors with a clear message instead of parking
+                    # the frame for eternity, and a counted share
+                    # metric so a missing remote stage is VISIBLE.
+                    retry_limit = int(parse_number(
+                        stream.parameters.get(
+                            "remote_retry_limit",
+                            self._pipeline_parameters.get(
+                                "remote_retry_limit")),
+                        REMOTE_RETRY_LIMIT_DEFAULT))
+                    if retry_limit and frame.remote_retries \
+                            >= retry_limit:
+                        self._frame_error(
+                            stream, frame,
+                            f"remote stage {node.name} undiscovered "
+                            f"after {frame.remote_retries} retries "
+                            f"(remote_retry_limit={retry_limit}); "
+                            f"is the remote pipeline running?")
+                        return
                     delay = min(
                         _REMOTE_RETRY_BASE * (2 ** frame.remote_retries),
                         _REMOTE_RETRY_CAP)
@@ -899,6 +1469,9 @@ class Pipeline(Actor):
                     rss_before = process_memory_rss()
                 ledger = self.transfer_ledger
                 try:
+                    if self._faults is not None:
+                        self._inject_element_fault(node.name,
+                                                   stream.stream_id)
                     if element.device_resident and ledger.active:
                         # Device elements run under the transfer guard:
                         # an implicit device->host sync inside one is a
@@ -914,6 +1487,8 @@ class Pipeline(Actor):
                     self.logger.exception("element %s raised", node.name)
                     self._element_post_error(stream, frame, node.name,
                                              start)
+                    if self._recover_after_dispatch_error(stream, frame):
+                        return      # chips died: frame replayed/bounded
                     self._frame_error(stream, frame,
                                       f"{node.name}: {error}")
                     return
@@ -1049,6 +1624,9 @@ class Pipeline(Actor):
                                    "time": time.perf_counter() - start})
 
         try:
+            if self._faults is not None:
+                self._inject_segment_fault(segment.name,
+                                           stream.stream_id)
             if ledger.active:
                 # The whole segment is device-element event-loop work:
                 # one guard scope around the single dispatch.
@@ -1066,12 +1644,14 @@ class Pipeline(Actor):
                 # ground truth -- poison and fall back (a genuine data
                 # error will resurface there with a per-element
                 # diagnostic).
-                segment.broken = True
                 self.logger.exception(
                     "segment %s: trace/compile failed; falling back to "
                     "per-element execution", segment.name)
+                segment.poison(f"trace/compile failed: {error}")
                 return False
             self.logger.exception("segment %s raised", segment.name)
+            if self._recover_after_dispatch_error(stream, frame):
+                return None     # chips died: frame replayed/bounded
             self._frame_error(stream, frame, f"{segment.name}: {error}")
             return None
         return self._segment_finish(stream, frame, segment, out,
@@ -1157,6 +1737,16 @@ class Pipeline(Actor):
                 self.stage_scheduler.cancel_reservation(node_name)
             self._pump_stage(node_name)
             return
+        if self._past_deadline(frame):
+            # Deadline enforcement at the admission boundary: an
+            # expired frame must not take a stage credit.  Its own
+            # reservation (when popped from the queue) goes back, and
+            # the next waiter gets a chance at the freed capacity.
+            if from_queue and self.stage_scheduler is not None:
+                self.stage_scheduler.cancel_reservation(node_name)
+            self._deadline_fail(stream, frame)
+            self._pump_stage(node_name)
+            return
         scheduler = self.stage_scheduler
         if scheduler is not None and frame.stage != node_name:
             if not scheduler.try_admit(node_name,
@@ -1192,6 +1782,13 @@ class Pipeline(Actor):
                                    "stream": stream.stream_id,
                                    "frame": frame.frame_id,
                                    "generation": frame.stage_generation})
+            if self._faults is not None:
+                rule = self._faults.should("stage_stall",
+                                           target=node_name,
+                                           stream=stream.stream_id)
+                if rule is not None:
+                    scheduler.executor(node_name).stall(
+                        rule.delay_ms / 1000.0)
         if not self._resume_walk_at(stream, frame, node_name, fuse=True):
             self._frame_error(
                 stream, frame,
@@ -1254,6 +1851,7 @@ class Pipeline(Actor):
         frame.paused_pe_name = node.name
         stream_id, frame_id = stream.stream_id, frame.frame_id
         node_name = node.name
+        epoch = frame.replay_epoch      # stale after a replay
         submitted = time.perf_counter()
         frame.metrics[f"{node_name}_time_start"] = submitted
         if element.device_resident:
@@ -1265,6 +1863,8 @@ class Pipeline(Actor):
             start = time.perf_counter()
             _THREAD_STREAM.stream = stream
             try:
+                if self._faults is not None:
+                    self._inject_element_fault(node_name, stream_id)
                 if element.device_resident and ledger.active:
                     with ledger.guard():
                         result = element.process_frame(stream, **inputs)
@@ -1286,13 +1886,13 @@ class Pipeline(Actor):
                            [stream_id, frame_id, node_name, event,
                             outputs, start,
                             time.perf_counter() - start, submitted,
-                            frame])
+                            frame, epoch])
 
         self.stage_scheduler.executor(node_name).submit(job)
 
     def resume_stage_frame(self, stream_id, frame_id, node_name, event,
                            outputs, exec_start, elapsed, submitted,
-                           frame_ref):
+                           frame_ref, epoch=None):
         """Continuation: a stage worker finished a synchronous placed
         element.  The post carries the Frame OBJECT it executed for: a
         stale post from a destroyed stream must never resume a
@@ -1307,6 +1907,9 @@ class Pipeline(Actor):
         frame = stream.frames.get(int(frame_id))
         if frame is not frame_ref:
             return              # stale post from a prior incarnation
+        if frame is not None \
+                and epoch is not None and epoch != frame.replay_epoch:
+            return              # pre-replay attempt: results are void
         if frame is not None and frame.paused_pe_name == node_name:
             frame.metrics[f"{node_name}_time_start"] = exec_start
             frame.metrics[f"{node_name}_queue_ms"] = \
@@ -1325,6 +1928,7 @@ class Pipeline(Actor):
         resolved, donated, _compiling, _submitted = begun
         frame.paused_pe_name = segment.name
         stream_id, frame_id = stream.stream_id, frame.frame_id
+        epoch = frame.replay_epoch      # stale after a replay
         ledger = self.transfer_ledger
 
         def job():
@@ -1338,6 +1942,8 @@ class Pipeline(Actor):
             # transient data error permanently poison the segment.
             compile_now = segment.would_compile(resolved, donated)
             try:
+                if self._faults is not None:
+                    self._inject_segment_fault(segment.name, stream_id)
                 if ledger.active:
                     with ledger.guard():
                         out = segment.call(resolved, donated)
@@ -1354,14 +1960,16 @@ class Pipeline(Actor):
             self.post_self("resume_stage_segment",
                            [stream_id, frame_id, segment, out,
                             diagnostic, resolved, donated, compile_now,
-                            start, time.perf_counter() - start, frame])
+                            start, time.perf_counter() - start, frame,
+                            epoch])
 
         self.stage_scheduler.executor(segment.stage_context).submit(job)
         return True
 
     def resume_stage_segment(self, stream_id, frame_id, segment, out,
                              diagnostic, resolved, donated, compiling,
-                             exec_start, elapsed, frame_ref):
+                             exec_start, elapsed, frame_ref,
+                             epoch=None):
         """Continuation: a stage worker finished (or failed) a fused
         segment dispatch; map out and keep walking after the segment.
         Frame identity is validated (like resume_stage_frame) so stale
@@ -1372,6 +1980,8 @@ class Pipeline(Actor):
         if frame is None or frame is not frame_ref \
                 or frame.paused_pe_name != segment.name:
             return
+        if epoch is not None and epoch != frame.replay_epoch:
+            return              # pre-replay attempt: results are void
         frame.paused_pe_name = None
         for node in segment.nodes:
             frame.metrics[f"{node.name}_time_start"] = exec_start
@@ -1392,15 +2002,18 @@ class Pipeline(Actor):
                 # First-signature trace/compile failure: poison the
                 # segment and replay per-element -- the cached plan
                 # splices broken segments on the next walk.
-                segment.broken = True
                 self.logger.error(
                     "segment %s: stage-worker trace/compile failed; "
                     "falling back to per-element execution",
                     segment.name)
+                segment.poison(f"stage-worker trace/compile failed: "
+                               f"{diagnostic}")
                 if self._resume_walk_at(stream, frame,
                                         segment.nodes[0].name,
                                         fuse=True):
                     return
+            if self._recover_after_dispatch_error(stream, frame):
+                return          # chips died: frame replayed/bounded
             self._frame_error(stream, frame,
                               f"{segment.name}: {diagnostic}")
             return
@@ -1425,6 +2038,7 @@ class Pipeline(Actor):
         frame.paused_pe_name = node.name
         stream_id, frame_id = stream.stream_id, frame.frame_id
         node_name = node.name
+        epoch = frame.replay_epoch      # stale after a replay
         start = time.perf_counter()
         frame.metrics[f"{node_name}_time_start"] = start
         if node.element.device_resident:
@@ -1443,10 +2057,12 @@ class Pipeline(Actor):
             self.post_self("resume_frame_local",
                            [stream_id, frame_id, node_name, event,
                             outputs or {},
-                            time.perf_counter() - start, frame])
+                            time.perf_counter() - start, frame, epoch])
 
         ledger = self.transfer_ledger
         try:
+            if self._faults is not None:
+                self._inject_element_fault(node_name, stream_id)
             if node.element.device_resident and ledger.active:
                 # The submit path is device-element event-loop work
                 # too: an implicit host sync here blocks every stream.
@@ -1472,10 +2088,13 @@ class Pipeline(Actor):
                 state["done"] = True    # a late complete() must not win
             frame.paused_pe_name = None
             self._element_post_error(stream, frame, node_name, start)
+            if self._recover_after_dispatch_error(stream, frame):
+                return          # chips died: frame replayed/bounded
             self._frame_error(stream, frame, f"{node_name}: {error}")
 
     def resume_frame_local(self, stream_id, frame_id, node_name,
-                           event, outputs, elapsed, frame_ref=None):
+                           event, outputs, elapsed, frame_ref=None,
+                           epoch=None):
         """Continuation: a parked async LOCAL stage completed (the local
         analogue of ``process_frame_response``).  ``frame_ref`` (when
         the poster holds the Frame object) guards against a stale
@@ -1490,6 +2109,8 @@ class Pipeline(Actor):
             return
         if frame_ref is not None and frame is not frame_ref:
             return                      # stale post: frame was replaced
+        if epoch is not None and epoch != frame.replay_epoch:
+            return                      # pre-replay attempt: void
         frame.paused_pe_name = None
         frame.metrics[f"{node_name}_time"] = elapsed
         self.run_hook("pipeline.process_element_post:0",
@@ -1523,6 +2144,9 @@ class Pipeline(Actor):
             return
         diagnostic = outputs.get("diagnostic", "") \
             if event == StreamEvent.ERROR else f"bad event {event!r}"
+        if event == StreamEvent.ERROR \
+                and self._recover_after_dispatch_error(stream, frame):
+            return              # chips died: frame replayed/bounded
         self._frame_error(stream, frame, f"{node_name}: {diagnostic}")
 
     def _readmit_frame(self, stream: Stream, frame: Frame) -> bool:
@@ -1575,9 +2199,16 @@ class Pipeline(Actor):
         """Returns (inputs, missing, host_typed): the host-typed names
         were materialized host-side and must stay there -- a placement
         transfer re-uploading them would undo the contract."""
-        element = node.element
+        return self._map_in_for(node.element, node.properties or {},
+                                swag)
+
+    def _map_in_for(self, element, mapping: dict, swag: dict) \
+            -> tuple[dict, list, list]:
+        """`_map_in` against an explicit (element, mapping) pair -- the
+        graph path shares it with breaker fallbacks, whose element is
+        off-graph but resolves inputs through the remote node's
+        mapping."""
         inputs, missing, host_typed = {}, [], []
-        mapping = node.properties or {}
         host_inputs = element.host_inputs
         for io in (element.definition.input if element.definition else []):
             name = io["name"]
@@ -1645,10 +2276,20 @@ class Pipeline(Actor):
             # Provenance for fused-segment donation: only values an
             # element of THIS frame produced are ever donatable.
             frame.produced[name] = node.name
+        # Replay frontier (ISSUE 5): outputs accepted -> this element
+        # never re-executes when the frame replays across a device
+        # replacement.
+        frame.completed.add(node.name)
 
     # -- completion / errors / responses ----------------------------------
 
     def _frame_done(self, stream: Stream, frame: Frame, nodes):
+        if self._past_deadline(frame):
+            # Deadline enforcement at delivery: the work finished, but
+            # late IS wrong under an SLO -- the slot carries a deadline
+            # error, not a stale result.
+            self._deadline_fail(stream, frame)
+            return
         frame.metrics["time_pipeline"] = (
             time.perf_counter() - frame.metrics["time_pipeline_start"])
         stream.last_frame_time = time.monotonic()   # grace lease clock
@@ -1738,13 +2379,30 @@ class Pipeline(Actor):
                 self._respond(stream, pending_frame, okay, diagnostic)
 
     def _frame_error(self, stream: Stream, frame: Frame, diagnostic: str):
+        """Fatal frame failure: the stream enters ERROR and tears down
+        (reference semantics -- an element error poisons the stream)."""
         self.logger.error("stream %s frame %s: %s",
                           stream.stream_id, frame.frame_id, diagnostic)
+        self._finish_failed_frame(stream, frame, diagnostic)
+        stream.state = StreamState.ERROR
+        self.post_self("destroy_stream", [stream.stream_id])
+
+    def _frame_fail(self, stream: Stream, frame: Frame, diagnostic: str):
+        """Per-frame failure on a HEALTHY stream (deadline miss,
+        overload shed, open circuit breaker): the frame delivers an
+        error in its reorder slot, the stream keeps running.  This is
+        the load-shedding contract -- an SLO miss must not amplify into
+        a stream teardown."""
+        self.logger.warning("stream %s frame %s: %s",
+                            stream.stream_id, frame.frame_id, diagnostic)
+        self._finish_failed_frame(stream, frame, diagnostic)
+
+    def _finish_failed_frame(self, stream: Stream, frame: Frame,
+                             diagnostic: str):
         stream.frames.pop(frame.frame_id, None)
         self._release_stage(stream, frame)
         if self.telemetry is not None:
             self.telemetry.frame_finished(stream, frame, okay=False)
-        stream.state = StreamState.ERROR
         if frame.delivery_seq is not None:
             # Deliver the error IN its slot so already-completed
             # successors' buffered okay-responses flush behind it
@@ -1756,7 +2414,6 @@ class Pipeline(Actor):
         else:
             self._respond(stream, frame, okay=False,
                           diagnostic=diagnostic)
-        self.post_self("destroy_stream", [stream.stream_id])
 
     def _respond(self, stream: Stream, frame: Frame, okay: bool,
                  diagnostic: str = ""):
@@ -1830,6 +2487,14 @@ class Pipeline(Actor):
         frame = stream.frames.get(frame_id)
         if frame is None or frame.paused_pe_name is None:
             return
+        if frame.paused_pe_name not in self.graph or not isinstance(
+                self.graph.get_node(frame.paused_pe_name).element,
+                RemoteStage):
+            # Duplicate or late response (wire_dup fault, MQTT QoS1
+            # redelivery): the frame has moved on and is parked at a
+            # LOCAL element/segment now -- mapping remote outputs under
+            # that node would silently replace its real result.
+            return
         okay = str(stream_dict.get("okay", "true")).lower() != "false"
         if self.telemetry is not None:
             # Close the hop span and merge the remote pipeline's spans
@@ -1847,12 +2512,28 @@ class Pipeline(Actor):
             remote_spans = stream_dict.get("spans")
             if remote_spans:
                 frame.spans.extend(decode_spans(remote_spans))
+        breaker = self._stage_breaker(frame.paused_pe_name) \
+            if frame.paused_pe_name in self.graph else None
         if not okay:
+            if breaker is not None:
+                breaker.record_failure()
             self._frame_error(stream, frame,
                               f"remote {frame.paused_pe_name}: "
                               f"{stream_dict.get('diagnostic', '')}")
             return
-        outputs = decode_frame_data(dict(frame_data or {}))
+        try:
+            outputs = decode_frame_data(dict(frame_data or {}))
+        except Exception as error:
+            # A corrupt-but-parseable response payload: counts against
+            # the stage's breaker like any other remote failure.
+            if breaker is not None:
+                breaker.record_failure()
+            self._frame_error(stream, frame,
+                              f"remote {frame.paused_pe_name}: "
+                              f"undecodable response ({error})")
+            return
+        if breaker is not None:
+            breaker.record_success()
         node = self.graph.get_node(frame.paused_pe_name)
         self._map_out(node, frame, outputs)
         resume_after = frame.paused_pe_name
@@ -1919,6 +2600,7 @@ class Pipeline(Actor):
 
     def stop(self):
         self._cancel_health_timer()
+        self.disarm_faults()
         for stream_id in list(self.streams):
             self._destroy_stream_now(stream_id)
         if self.stage_scheduler is not None:

@@ -102,6 +102,16 @@ class StageExecutor:
                 "stage %s: worker job raised", self.name)
         self.executed += 1
 
+    def stall(self, seconds: float) -> None:
+        """Chaos harness (``stage_stall`` fault point): occupy the FIFO
+        worker for ``seconds`` -- every job queued behind it waits,
+        exactly like a stage whose chips went quiet mid-stream.  Rides
+        the normal queue, so ordering invariants still hold."""
+        delay = float(seconds)
+        _logger.warning("stage %s: injected %.0f ms worker stall",
+                        self.name, delay * 1000.0)
+        self.submit(lambda: time.sleep(delay))
+
     def stop(self):
         self._stopped = True
         self._pool.shutdown(wait=False)

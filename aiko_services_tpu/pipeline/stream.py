@@ -105,6 +105,20 @@ class Frame:
     # Open remote-hop span while parked at a RemoteStage:
     # (node_name, span_id, wall start).
     remote_span: tuple | None = None
+    # Failure recovery (ISSUE 5): the frame's absolute deadline
+    # (monotonic seconds, None = no deadline), how many times it has
+    # been replayed across a device replacement, and the replay epoch
+    # -- bumped on every replay so in-flight stage-worker/async
+    # completions from the PREVIOUS attempt read as stale when their
+    # continuation posts land.
+    deadline: float | None = None
+    replays: int = 0
+    replay_epoch: int = 0
+    # Elements whose outputs this frame has accepted (map-out ran):
+    # the replay frontier.  A replayed frame resumes at the first path
+    # node NOT in here -- everything before it is host-visible in the
+    # swag and must not re-execute.
+    completed: set = field(default_factory=set)
 
 
 @dataclass
@@ -145,6 +159,16 @@ class Stream:
     delivery_count: int = 0
     delivery_next: int = 0
     delivery_pending: dict = field(default_factory=dict)
+    # Failure recovery (ISSUE 5), resolved once at stream creation:
+    # ``frame_deadline_ms`` (0 = none) stamps every ingested frame's
+    # deadline; ``overload_policy``/``overload_limit`` bound the
+    # stream's in-flight queue depth for live streams --
+    # ``shed_oldest`` cancels the oldest admission-queued frame,
+    # ``shed_newest`` refuses the incoming one, ``block`` (default)
+    # keeps the pre-existing backpressure-only behavior.
+    deadline_ms: float = 0.0
+    overload_policy: str = "block"
+    overload_limit: int = 0
 
     def next_frame_id(self) -> int:
         frame_id = self.frame_count

@@ -326,6 +326,15 @@ class StagePlacement:
     def plan(self, stage: str) -> MeshPlan:
         return self.plans[stage]
 
+    def stage_devices(self, stage: str) -> set:
+        """The devices a stage's submesh currently occupies (empty for
+        an unknown stage) -- the chaos harness's ``device_kill`` target
+        resolution and the replay path's blast-radius checks."""
+        plan = self.plans.get(stage)
+        if plan is None:
+            return set()
+        return set(plan.mesh.devices.flat)
+
     # -- stage hops --------------------------------------------------------
 
     def stage_sharding(self, stage: str, spec: tuple = ()) -> NamedSharding:

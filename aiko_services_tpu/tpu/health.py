@@ -44,15 +44,21 @@ def default_prober(device) -> bool:
 
 
 def probe_devices(devices: Sequence, prober: Callable | None = None,
-                  timeout: float = PROBE_TIMEOUT) -> list:
+                  timeout: float | None = None) -> list:
     """Probe every device; returns the list that FAILED.
 
     Probes run concurrently on a worker pool with a deadline, so the
     caller (usually the single-threaded event engine) blocks for at most
     ~``timeout`` even when a chip *hangs* instead of erroring -- a hung
     probe counts as failed.  The worker servicing a truly hung transfer
-    is abandoned (daemon thread), never joined on."""
+    is abandoned (daemon thread), never joined on.
+
+    ``timeout=None`` uses :data:`PROBE_TIMEOUT`; pipelines plumb their
+    ``health_probe_timeout`` parameter through here
+    (``Pipeline.check_device_health``), so deployments with slow links
+    (TPU tunnels) or tight failover SLOs tune it without patching."""
     prober = prober or default_prober
+    timeout = PROBE_TIMEOUT if timeout is None else float(timeout)
     devices = list(devices)
     if not devices:
         return []

@@ -28,6 +28,13 @@ class LoopbackBroker:
         self._lock = threading.RLock()
         self._clients: list["LoopbackMessage"] = []
         self._retained: dict[str, object] = {}
+        # Chaos harness hook (aiko_services_tpu/faults): when set,
+        # every publish passes through ``filter(topic, payload) ->
+        # (topic, payload) | None`` (None = drop) BEFORE retention and
+        # delivery -- wire-level drop/delay/duplicate/corrupt faults
+        # exercised on the real message path.  None (the default) costs
+        # one attribute read per publish.
+        self._fault_filter = None
 
     def attach(self, client: "LoopbackMessage"):
         with self._lock:
@@ -46,7 +53,23 @@ class LoopbackBroker:
                                                   {}).values():
                 self.publish(topic, payload, retain)
 
+    def set_fault_filter(self, fault_filter) -> None:
+        """Install (or clear, with None) the wire fault filter."""
+        with self._lock:
+            self._fault_filter = fault_filter
+
     def publish(self, topic: str, payload, retain: bool = False):
+        fault_filter = self._fault_filter
+        if fault_filter is not None:
+            passed = fault_filter(topic, payload)
+            if passed is None:
+                return                  # injected wire drop/delay
+            topic, payload = passed
+        self.publish_direct(topic, payload, retain)
+
+    def publish_direct(self, topic: str, payload, retain: bool = False):
+        """Publish bypassing the fault filter -- delayed/duplicated
+        redelivery from the filter itself must not re-enter it."""
         if retain:
             with self._lock:
                 if payload in (None, "", b""):
@@ -67,6 +90,7 @@ class LoopbackBroker:
         with self._lock:
             self._clients.clear()
             self._retained.clear()
+            self._fault_filter = None
 
 
 _BROKER = LoopbackBroker()
