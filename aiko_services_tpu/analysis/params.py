@@ -1,0 +1,141 @@
+"""Pipeline-parameter registry (ISSUE 6).
+
+One authoritative table of every engine-level pipeline parameter: its
+value domain (for the ``bad-parameter`` dataflow rule) and a one-line
+description (the README "Static analysis & pre-flight" table renders
+from the same data).  The framework self-check's
+``parameter-registry`` rule keeps this table honest both ways: every
+parameter literal the engine reads must be registered AND documented
+in README.md, and every registered parameter must still be read
+somewhere -- so the table can neither rot nor drift.
+
+Element-level parameters (``width``, ``max_new_tokens``, ...) are the
+element author's namespace and deliberately NOT registered here; the
+``unread-parameter`` residency rule covers those per class.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .findings import Finding
+
+__all__ = ["ParamSpec", "PIPELINE_PARAMETERS", "validate_parameters"]
+
+
+@dataclass(frozen=True)
+class ParamSpec:
+    description: str
+    choices: tuple = ()             # enum domain ("" allows absence)
+    number: bool = False            # must parse as a number
+    minimum: float | None = None    # inclusive lower bound
+    kind: str = "string"            # free-form: string | json
+
+
+PIPELINE_PARAMETERS: dict[str, ParamSpec] = {
+    "transfer_guard": ParamSpec(
+        "device-resident swag policy for device elements",
+        choices=("allow", "log", "disallow")),
+    "fuse": ParamSpec(
+        "fused device-segment compilation", choices=("auto", "off")),
+    "stage_pipeline": ParamSpec(
+        "stage-parallel execution over placed submeshes",
+        choices=("auto", "off")),
+    "preflight": ParamSpec(
+        "static pre-flight at pipeline create: on (errors fail), "
+        "strict (warnings fail too), off",
+        choices=("on", "strict", "off")),
+    "telemetry": ParamSpec(
+        "telemetry plane (histograms, traces, /metrics)",
+        choices=("on", "off", "true", "false", "0", "1")),
+    "overload_policy": ParamSpec(
+        "live-stream overload behavior",
+        choices=("block", "shed_oldest", "shed_newest")),
+    "device_inflight": ParamSpec(
+        "bounded async-dispatch window depth (0 disables)",
+        number=True, minimum=0),
+    "stage_inflight": ParamSpec(
+        "per-stage admission-window credits", number=True, minimum=1),
+    "overload_limit": ParamSpec(
+        "in-flight frames before the overload policy engages "
+        "(0 disables)", number=True, minimum=0),
+    "frame_deadline_ms": ParamSpec(
+        "per-frame deadline in ms (0 disables)",
+        number=True, minimum=0),
+    "replay_limit": ParamSpec(
+        "replays per frame across device replacements (0 = unbounded)",
+        number=True, minimum=0),
+    "remote_retry_limit": ParamSpec(
+        "undiscovered-remote retries before the frame errors "
+        "(0 = forever)", number=True, minimum=0),
+    "breaker_threshold": ParamSpec(
+        "consecutive remote failures that open the circuit breaker "
+        "(0 disables)", number=True, minimum=0),
+    "breaker_cooldown_ms": ParamSpec(
+        "breaker open time before the half-open probe",
+        number=True, minimum=0),
+    "health_check_interval": ParamSpec(
+        "periodic device health probe interval in seconds "
+        "(absent = off)", number=True, minimum=0),
+    "health_probe_timeout": ParamSpec(
+        "per-probe deadline in seconds (hung chip counts as dead)",
+        number=True, minimum=0),
+    "telemetry_window": ParamSpec(
+        "histogram rotation window in seconds", number=True, minimum=0),
+    "telemetry_interval": ParamSpec(
+        "share-dict telemetry publish interval in seconds",
+        number=True, minimum=0),
+    "trace_capacity": ParamSpec(
+        "bounded TraceBuffer size", number=True, minimum=1),
+    "compile_cache_dir": ParamSpec(
+        "persistent XLA compile cache directory"),
+    "fault_plan": ParamSpec(
+        "chaos FaultPlan armed at startup (rules list / JSON)",
+        kind="json"),
+}
+
+
+def _parse_number(value):
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def validate_parameters(parameters: dict, where: str) -> list:
+    """``bad-parameter`` findings for one parameters dict (pipeline
+    definition level, or a stream-parameters default block)."""
+    findings: list[Finding] = []
+    for name, spec in PIPELINE_PARAMETERS.items():
+        if name not in parameters:
+            continue
+        value = parameters[name]
+        spot = f"{where}.parameters.{name}"
+        if spec.choices:
+            normalized = str(value).strip().lower()
+            if normalized not in spec.choices:
+                findings.append(Finding(
+                    "bad-parameter",
+                    f"{name}={value!r}: one of "
+                    f"{'|'.join(spec.choices)}", spot))
+            continue
+        if spec.number:
+            number = _parse_number(value)
+            if number is None:
+                findings.append(Finding(
+                    "bad-parameter",
+                    f"{name}={value!r}: expected a number", spot))
+            elif spec.minimum is not None and number < spec.minimum:
+                findings.append(Finding(
+                    "bad-parameter",
+                    f"{name}={value!r}: must be >= "
+                    f"{spec.minimum:g}", spot))
+            continue
+        if spec.kind == "json" and name == "fault_plan" and value:
+            try:
+                from ..faults import FaultPlan
+                FaultPlan.parse(value)
+            except (ValueError, TypeError) as error:
+                findings.append(Finding(
+                    "bad-parameter", f"fault_plan: {error}", spot))
+    return findings

@@ -142,9 +142,14 @@ def pipeline():
                    "@path/to/plan.json (see README 'Failure model'); "
                    "arm/disarm a RUNNING pipeline with "
                    "'pipeline update NAME -p fault_plan <json|off>'")
+@click.option("--check", "strict_preflight", is_flag=True,
+              help="strict pre-flight: refuse to start on lint "
+                   "WARNINGS too (overrides the definition's "
+                   "'preflight' parameter, including 'off')")
 def pipeline_create(definition_pathname, transport, name, stream_id,
                     frame_data, parameters, frame_rate, profile_dir,
-                    hooks_spec, metrics_port, metrics_host, fault_plan):
+                    hooks_spec, metrics_port, metrics_host, fault_plan,
+                    strict_preflight):
     """Create a Pipeline from DEFINITION_PATHNAME (JSON) and run it."""
     from .pipeline import create_pipeline
     from .utils import parse_value
@@ -163,8 +168,9 @@ def pipeline_create(definition_pathname, transport, name, stream_id,
         except (ValueError, TypeError) as error:
             raise click.BadParameter(f"--fault-plan: {error}")
     runtime = _runtime(transport)
-    instance = create_pipeline(definition_pathname, name=name,
-                               runtime=runtime)
+    instance = create_pipeline(
+        definition_pathname, name=name, runtime=runtime,
+        preflight="strict" if strict_preflight else None)
     if fault_plan:
         instance.arm_faults(fault_plan)
     if hook_names:
@@ -327,6 +333,42 @@ def pipeline_validate(definition_pathname):
         {"name": definition.name,
          "graph": definition.graph,
          "elements": definition.element_names()}, indent=2))
+
+
+# -- static analysis --------------------------------------------------------
+
+@main.command("lint")
+@click.argument("paths", nargs=-1)
+@click.option("--self", "self_check", is_flag=True,
+              help="run the framework self-check rules over the "
+                   "aiko_services_tpu sources (hook parity, span sync, "
+                   "resume-post identity, parameter registry)")
+@click.option("--strict", is_flag=True,
+              help="exit 1 on warnings too (the `pipeline create "
+                   "--check` gate)")
+@click.option("--rules", "list_rules", is_flag=True,
+              help="print the rule catalogue and exit")
+def lint(paths, self_check, strict, list_rules):
+    """aiko_lint: static dataflow, residency, and contract analysis.
+
+    PATHS are pipeline definitions (.json) and/or element sources
+    (.py files or directories).  Definitions get the dataflow +
+    residency layers (exactly what `pipeline create` pre-flights);
+    element sources get the residency rules standalone.  Exit 0 clean,
+    1 on error findings (or any finding under --strict).
+    """
+    from .analysis import RULES, run_lint
+
+    if list_rules:
+        for rule, (severity, description) in RULES.items():
+            click.echo(f"{rule:24} {severity:8} {description}")
+        return
+    if not paths and not self_check:
+        raise click.UsageError(
+            "nothing to lint: pass definition/source paths, --self, "
+            "or --rules")
+    sys.exit(run_lint(paths, self_check=self_check, strict=strict,
+                      echo=click.echo))
 
 
 # -- weight conversion ------------------------------------------------------

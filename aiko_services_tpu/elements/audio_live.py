@@ -276,6 +276,8 @@ class SpeakerWrite(DataTarget):
     plays each frame's ``audio`` (reference PE_Speaker,
     audio_io.py:540-564)."""
 
+    host_inputs = ("audio",)    # sink: the engine fetches explicitly
+
     def process_frame(self, stream: Stream, audio=None, sample_rate=None,
                       **inputs):
         key = _speaker_key(self.name)

@@ -61,6 +61,8 @@ class ASR(PipelineElement):
     (same contract as the reference's resampler -> whisper chain).
     """
 
+    host_inputs = ("audio",)    # np.asarray front door: one counted fetch
+
     _SIZES = {"tiny": asr_model.AsrConfig.tiny,
               "base": asr_model.AsrConfig.base}
 

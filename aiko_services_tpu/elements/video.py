@@ -69,6 +69,8 @@ class VideoWriteFile(DataTarget):
     video_io.py:263-337).  Writer opens lazily on the first frame (codec
     from the ``codec`` parameter, default MJPG; rate from ``rate``)."""
 
+    host_inputs = ("image",)    # sink: the engine fetches explicitly
+
     def process_frame(self, stream, image=None, **inputs):
         if not _HAVE_CV2:
             return StreamEvent.ERROR, {"diagnostic": "cv2 missing"}

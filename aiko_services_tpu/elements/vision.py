@@ -115,6 +115,8 @@ class FaceDetect(PipelineElement):
     Cumulative detection count is shared as ``{element}.detections``
     (reference ``self.share["detections"]``)."""
 
+    host_inputs = ("image",)    # cv2 runs on host: one counted fetch
+
     def __init__(self, context):
         super().__init__(context)
         self._backend = None
@@ -158,6 +160,8 @@ class ArucoMarkerDetect(PipelineElement):
 
     Parameter ``aruco_tags`` selects the dictionary by its cv2 name
     (default ``DICT_4X4_50``, the reference default)."""
+
+    host_inputs = ("image",)    # cv2 runs on host: one counted fetch
 
     def __init__(self, context):
         super().__init__(context)

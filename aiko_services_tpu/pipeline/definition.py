@@ -41,6 +41,9 @@ class ElementDefinition:
     # deployed, off-graph) element definition to run in place of this
     # remote stage while its circuit breaker is open.
     fallback: str | None = None
+    # Static-analysis escape hatch (ISSUE 6): ``"lint": ["dead-output"]``
+    # suppresses those rules for THIS element in aiko_lint/pre-flight.
+    lint_disable: tuple = ()
 
     @property
     def input_names(self) -> list[str]:
@@ -59,12 +62,16 @@ class PipelineDefinition:
     graph: list[str]
     parameters: dict = field(default_factory=dict)
     elements: list[ElementDefinition] = field(default_factory=list)
+    # Pipeline-wide lint suppressions (``"lint": [...]`` at top level).
+    lint_disable: tuple = ()
 
     def element(self, name: str) -> ElementDefinition:
         for element in self.elements:
             if element.name == name:
                 return element
-        raise DefinitionError(f"no element definition for {name!r}")
+        raise DefinitionError(
+            f"pipeline {self.name!r}: graph node {name!r} has no "
+            f"element definition (defined: {self.element_names()})")
 
     def element_names(self) -> list[str]:
         return [e.name for e in self.elements]
@@ -94,6 +101,55 @@ def _parse_io(entries, path: str) -> list:
     return result
 
 
+def _parse_lint(value, path: str) -> tuple:
+    """``"lint": ["rule-a", ...]`` -- per-definition static-analysis
+    suppressions (the JSON twin of ``# aiko-lint: disable=...``).
+    Unknown rule ids are rejected: a typo'd suppression that silently
+    does nothing is exactly the kind of frame-N surprise lint exists
+    to prevent."""
+    if value is None:
+        return ()
+    if not isinstance(value, list) \
+            or not all(isinstance(rule, str) for rule in value):
+        raise DefinitionError(
+            f"{path}.lint: expected a list of rule-id strings")
+    from ..analysis.findings import RULES     # dependency-free module
+
+    unknown = sorted(set(value) - set(RULES))
+    if unknown:
+        raise DefinitionError(
+            f"{path}.lint: unknown rule(s) {unknown}; see "
+            f"'aiko_lint --rules' for the catalogue")
+    return tuple(value)
+
+
+def placement_error(block: dict) -> str | None:
+    """Why this placement block is malformed, or None.  The ONE
+    authority shared by ``Pipeline._build_placement`` (create-time
+    raise) and the dataflow analyzer's ``bad-placement`` rule, so the
+    two can never drift."""
+    if "mesh" in block:
+        mesh = block["mesh"]
+        if not isinstance(mesh, dict) or not mesh or not all(
+                isinstance(v, int) and not isinstance(v, bool) and v > 0
+                for v in mesh.values()):
+            return (f"mesh must map axis names to positive chip "
+                    f"counts, got {mesh!r}")
+        return None
+    if "devices" in block:
+        want = block["devices"]
+        if isinstance(want, str):
+            if want.strip().lower() != "auto":
+                return (f"placement devices must be a chip count or "
+                        f"'auto', got {want!r}")
+        elif not isinstance(want, int) or isinstance(want, bool) \
+                or want <= 0:
+            return (f"placement devices must be a positive chip "
+                    f"count or 'auto', got {want!r}")
+        return None
+    return f"placement needs 'mesh' or 'devices', got {sorted(block)}"
+
+
 def parse_pipeline_definition(data: dict | str,
                               source: str = "<definition>") \
         -> PipelineDefinition:
@@ -119,6 +175,7 @@ def parse_pipeline_definition(data: dict | str,
     parameters = data.get("parameters", {})
     if not isinstance(parameters, dict):
         raise DefinitionError(f"{source}.parameters: expected an object")
+    lint_disable = _parse_lint(data.get("lint"), source)
 
     elements_data = _require(data, "elements", list, source)
     elements = []
@@ -158,7 +215,8 @@ def parse_pipeline_definition(data: dict | str,
             deploy_remote=deploy_remote,
             parameters=entry.get("parameters", {}),
             placement=entry.get("placement", {}),
-            fallback=fallback))
+            fallback=fallback,
+            lint_disable=_parse_lint(entry.get("lint"), path)))
 
     names = {element.name for element in elements}
     for element in elements:
@@ -176,7 +234,8 @@ def parse_pipeline_definition(data: dict | str,
 
     return PipelineDefinition(name=name, version=version, runtime=runtime,
                               graph=list(graph), parameters=parameters,
-                              elements=elements)
+                              elements=elements,
+                              lint_disable=lint_disable)
 
 
 def load_pipeline_definition(pathname: str) -> PipelineDefinition:

@@ -64,7 +64,11 @@ class PipelineElement:
     #: per element per frame) before ``process_frame`` -- the
     #: class-level complement of a definition input's
     #: ``"type": "host"``.  Everything else arrives as-is: device
-    #: values stay device-resident between device stages.
+    #: values stay device-resident between device stages.  The
+    #: ``undeclared-host-input`` lint rule (analysis/residency.py)
+    #: AST-checks ``process_frame`` bodies against this declaration at
+    #: ``pipeline create``, so a quiet ``np.asarray(input)`` sync is a
+    #: create-time finding instead of a frame-N transfer-guard error.
     host_inputs: tuple = ()
 
     def __init__(self, context: ElementContext):

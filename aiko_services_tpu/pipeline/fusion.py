@@ -96,6 +96,12 @@ class DeviceFn:
     values) with ONE counted ``TransferLedger.fetch`` and the callback
     builds the element's host-side outputs (``finalize_outputs``), e.g.
     the Detector's overlay/detections from its device slate.
+
+    The purity half of this contract is statically enforced: the
+    ``device-fn-host-call`` lint rule (analysis/residency.py) AST-scans
+    every ``device_fn`` trace body at ``pipeline create``, so a host
+    sync that would poison the fused segment on first trace is rejected
+    before any frame is dispatched.
     """
 
     fn: Callable

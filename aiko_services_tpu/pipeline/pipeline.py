@@ -29,7 +29,8 @@ from typing import Any
 
 from .codec import decode_frame_data, encode_frame_data
 from .definition import (PipelineDefinition, parse_pipeline_definition,
-                         load_pipeline_definition, DefinitionError)
+                         load_pipeline_definition, DefinitionError,
+                         placement_error)
 from .element import ElementContext, PipelineElement, PipelineElementLoop
 from .fusion import (FUSE_MODES, FusedSegment, partition,
                      setup_compilation_cache)
@@ -44,6 +45,7 @@ from ..observability import (HISTOGRAM_WINDOW_DEFAULT,
                              TRACE_CAPACITY_DEFAULT, PipelineTelemetry,
                              decode_spans, encode_spans, make_span,
                              mint_id)
+from ..analysis.lint import preflight as preflight_check
 from ..faults import (CircuitBreaker, FaultInjected, FaultPlan,
                       wire_fault_filter)
 from ..runtime import Lease
@@ -125,12 +127,25 @@ class RemoteStage(PipelineElement):
 class Pipeline(Actor):
     def __init__(self, definition: PipelineDefinition | dict | str,
                  name: str | None = None, runtime=None, tags=None,
-                 frame_codec=None):
+                 frame_codec=None, preflight: str | None = None):
         if not isinstance(definition, PipelineDefinition):
             definition = parse_pipeline_definition(definition)
         self.definition = definition
+        # Static pre-flight (ISSUE 6, analysis/): dataflow + residency
+        # analysis over the definition and its element sources, BEFORE
+        # the actor registers and before any device work.  A structural
+        # error (unbound input, dead mapping, malformed placement,
+        # impure DeviceFn, ...) raises a graph-path-qualified
+        # DefinitionError here instead of failing at frame N.
+        # ``preflight: strict`` makes warnings fatal too; ``off`` skips.
+        # The keyword (``pipeline create --check`` -> "strict") beats
+        # the definition's ``preflight`` parameter.
+        preflight_report = preflight_check(definition, mode=preflight)
         super().__init__(name or definition.name, PROTOCOL_PIPELINE,
                          tags=tags, runtime=runtime)
+        if preflight_report is not None:
+            for finding in preflight_report.findings:
+                self.logger.warning("pre-flight: %s", finding.render())
         self.streams: dict[str, Stream] = {}
         self._current_stream_ref: Stream | None = None
         self._pipeline_parameters = dict(definition.parameters)
@@ -230,28 +245,23 @@ class Pipeline(Actor):
             block = element_def.placement
             if not block:
                 continue
+            # Same authority as the lint rule (definition.py), so a
+            # 'preflight: off' definition cannot smuggle a malformed
+            # block past create into the runtime placement paths.
+            problem = placement_error(block)
+            if problem is not None:
+                raise DefinitionError(
+                    f"pipeline {self.definition.name!r}: "
+                    f"{element_def.name}.placement: {problem}")
             if "mesh" in block:
                 stages[element_def.name] = dict(block["mesh"])
-            elif "devices" in block:
+            else:
                 want = block["devices"]
                 # ``devices: auto`` splits the pool proportionally to
                 # measured per-stage cost (StagePlacement._resolve);
                 # equal split until profiles exist.
-                if isinstance(want, str) \
-                        and want.strip().lower() == "auto":
-                    stages[element_def.name] = "auto"
-                else:
-                    try:
-                        stages[element_def.name] = int(want)
-                    except (TypeError, ValueError):
-                        raise DefinitionError(
-                            f"element {element_def.name!r}: placement "
-                            f"devices must be a chip count or 'auto', "
-                            f"got {want!r}")
-            else:
-                raise DefinitionError(
-                    f"element {element_def.name!r}: placement needs "
-                    f"'mesh' or 'devices', got {sorted(block)}")
+                stages[element_def.name] = "auto" \
+                    if isinstance(want, str) else int(want)
         if not stages:
             return None
         from .tensor import StagePlacement
@@ -383,7 +393,8 @@ class Pipeline(Actor):
             context = ElementContext(node.name, element_def, self,
                                      dict(element_def.parameters))
             if element_def.deploy_local is not None:
-                cls = self._load_element_class(element_def.deploy_local)
+                cls = self._load_element_class(element_def.deploy_local,
+                                               node.name)
                 node.element = cls(context)
             else:
                 service_filter = ServiceFilter(
@@ -395,19 +406,22 @@ class Pipeline(Actor):
                 node.element = stage
         return graph
 
-    @staticmethod
-    def _load_element_class(deploy_local: dict):
+    def _load_element_class(self, deploy_local: dict,
+                            element_name: str = "?"):
+        context = (f"pipeline {self.definition.name!r}: "
+                   f"{element_name}.deploy.local")
         module = load_module(deploy_local["module"])
         class_name = deploy_local.get("class_name")
         if class_name is None:
             raise DefinitionError(
-                f"deploy.local needs class_name (module "
+                f"{context}: needs class_name (module "
                 f"{deploy_local['module']!r})")
         try:
             return getattr(module, class_name)
         except AttributeError:
             raise DefinitionError(
-                f"{deploy_local['module']}: no class {class_name!r}")
+                f"{context}: module {deploy_local['module']!r} has no "
+                f"class {class_name!r}")
 
     # -- parameters --------------------------------------------------------
 
@@ -896,7 +910,8 @@ class Pipeline(Actor):
         element = self._fallback_elements.get(node.name)
         if element is None:
             element_def = self.definition.element(fallback_name)
-            cls = self._load_element_class(element_def.deploy_local)
+            cls = self._load_element_class(element_def.deploy_local,
+                                           fallback_name)
             context = ElementContext(fallback_name, element_def, self,
                                      dict(element_def.parameters))
             element = self._fallback_elements[node.name] = cls(context)
@@ -2608,7 +2623,8 @@ class Pipeline(Actor):
         super().stop()
 
 
-def create_pipeline(definition_pathname: str, name=None, runtime=None) \
-        -> Pipeline:
+def create_pipeline(definition_pathname: str, name=None, runtime=None,
+                    preflight: str | None = None) -> Pipeline:
     definition = load_pipeline_definition(definition_pathname)
-    return Pipeline(definition, name=name, runtime=runtime)
+    return Pipeline(definition, name=name, runtime=runtime,
+                    preflight=preflight)

@@ -419,7 +419,11 @@ class TPUElement(PipelineElement):
     TPU elements are ``device_resident``: outputs may stay un-synced
     ``jax.Array`` (the engine only syncs at sinks / the bounded dispatch
     window), and event-loop execution runs under the pipeline's
-    transfer guard (pipeline/overlap.py).
+    transfer guard (pipeline/overlap.py).  The ``donation-alias`` lint
+    rule (analysis/residency.py) keys off this attribute at ``pipeline
+    create``: a graph mapping that reads a producer-qualified alias of
+    a device output another element overwrites pins the buffer and
+    blocks HBM donation for any fused segment containing it.
     """
 
     device_resident = True

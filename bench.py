@@ -123,14 +123,17 @@ def metrics_p50(rows, key) -> float:
 # 1. Control plane: 3-stage chained pipelines (the multitude topology).
 
 def element(name, cls, inputs, outputs, parameters=None,
-            module="aiko_services_tpu.elements.common"):
-    return {"name": name,
-            "input": [{"name": n} for n in inputs],
-            "output": [{"name": n} for n in outputs],
-            "deploy": {"local": {
-                "module": module,
-                "class_name": cls}},
-            "parameters": parameters or {}}
+            module="aiko_services_tpu.elements.common", lint=None):
+    entry = {"name": name,
+             "input": [{"name": n} for n in inputs],
+             "output": [{"name": n} for n in outputs],
+             "deploy": {"local": {
+                 "module": module,
+                 "class_name": cls}},
+             "parameters": parameters or {}}
+    if lint:
+        entry["lint"] = list(lint)
+    return entry
 
 
 def remote(name, target, inputs, outputs):
@@ -739,9 +742,12 @@ def bench_pipeline_e2e() -> dict:
         "parameters": {"transfer_guard": "disallow",
                        "device_inflight": 3},
         "elements": [
+            # lint: image/overlay are response-swag deliverables, not
+            # graph inputs -- dead-output is the point here.
             element("DET", "Detector", ["image"],
                     ["image", "overlay", "detections"],
-                    module="aiko_services_tpu.elements.detect"),
+                    module="aiko_services_tpu.elements.detect",
+                    lint=["dead-output"]),
             element("CAP", "DetectionCaption", ["detections"], ["text"],
                     module="aiko_services_tpu.elements.llm"),
             element("LLM", "LLM", ["text"], ["text"],
@@ -763,7 +769,18 @@ def bench_pipeline_e2e() -> dict:
                      "max_slots": E2E_FRAMES},
                     module="aiko_services_tpu.elements.llm"),
         ]}
-    pipeline = Pipeline(definition, runtime=runtime)
+    # Create-time pre-flight cost (ISSUE 6): the full dataflow +
+    # residency lint over this e2e definition, cold AST cache --
+    # the acceptance bar is < 100 ms so strict pre-flight is free at
+    # `pipeline create` scale.
+    from aiko_services_tpu.analysis import ModuleIndex, lint_definition
+    from aiko_services_tpu.pipeline import parse_pipeline_definition
+
+    parsed = parse_pipeline_definition(definition)
+    preflight_report = lint_definition(parsed, ModuleIndex())
+    preflight_ms = round(preflight_report.elapsed_ms, 1)
+
+    pipeline = Pipeline(parsed, runtime=runtime)
 
     rng = np.random.default_rng(0)
     responses: "queue.Queue" = queue.Queue()
@@ -852,6 +869,7 @@ def bench_pipeline_e2e() -> dict:
         "pipeline_e2e_p50_detect_ms": round(p50("DET_time") * 1000, 1),
         "pipeline_e2e_p50_caption_ms": round(p50("CAP_time") * 1000, 2),
         "pipeline_e2e_p50_llm_ms": round(p50("LLM_time") * 1000, 1),
+        "pipeline_preflight_ms": preflight_ms,
     }
 
     # -- tunnel-insensitive variant (VERDICT r3 item 8): the SAME engine

@@ -1,0 +1,3 @@
+# Deliberately unparseable element source: the most broken element
+# possible must NOT lint clean (rule: bad-source).
+class Broken(

@@ -1,0 +1,112 @@
+"""Deliberately broken element classes for the aiko_lint fixture
+corpus (tests/test_static_analysis.py).
+
+Each class triggers exactly ONE residency rule when referenced from its
+fixture definition; the ``Clean*`` classes exist so the fixture graphs
+have violation-free neighbors.  None of this is ever executed -- the
+analyzers AST-parse it without importing (jax never loads).
+"""
+
+import numpy as np
+
+from aiko_services_tpu.elements.image import as_uint8
+from aiko_services_tpu.pipeline import PipelineElement
+from aiko_services_tpu.pipeline.tensor import TPUElement
+
+
+def _as_uint8(value):
+    """Module-local wrapper around a host-materializing call: the
+    analyzer must trace through it."""
+    return np.asarray(value)
+
+
+def _via_import(value):
+    """Local wrapper around an IMPORTED host-materializing helper: the
+    forcing set must seed imports before its local fixpoint."""
+    return as_uint8(value)
+
+
+class UndeclaredHostInput(PipelineElement):
+    """np.asarray on a device input with no host_inputs declaration:
+    an implicit device->host sync the swag contract counts."""
+
+    def process_frame(self, stream, image=None):
+        pixels = np.asarray(image)          # undeclared-host-input
+        return True, {"n": int(pixels.size)}
+
+
+class DeviceFnHostCall(TPUElement):
+    """DeviceFn whose trace body host-materializes: the fused trace
+    would sync (or fail) under jax.jit."""
+
+    def device_fn(self, stream):
+        from aiko_services_tpu.pipeline import DeviceFn
+
+        def trace(image):
+            scale = np.asarray(image).mean()    # device-fn-host-call
+            return {"image": image * scale}
+
+        return DeviceFn(fn=trace, inputs=("image",), outputs=("image",))
+
+    def process_frame(self, stream, image=None):
+        return True, {"image": image}
+
+
+class NoParameters(PipelineElement):
+    """Reads no parameters at all -- the unread-parameter fixture
+    declares one on this element."""
+
+    def process_frame(self, stream, x=None):
+        return True, {"y": x}
+
+
+class DeviceProducer(TPUElement):
+    """Device-resident producer for the donation-alias fixture."""
+
+    device_resident = True
+
+    def process_frame(self, stream, x=None):
+        return True, {"out": x}
+
+
+class WrappedHostInput(PipelineElement):
+    """Same sync as UndeclaredHostInput, but hidden behind the
+    module-local ``_as_uint8`` helper."""
+
+    def process_frame(self, stream, image=None):
+        data = _as_uint8(image)             # undeclared-host-input
+        return True, {"n": int(data.size)}
+
+
+class ImportWrappedHostInput(PipelineElement):
+    """Same sync again, through a local wrapper around an imported
+    helper (``as_uint8`` lives in elements/image.py)."""
+
+    def process_frame(self, stream, image=None):
+        data = _via_import(image)           # undeclared-host-input
+        return True, {"n": int(data.size)}
+
+
+class SuppressedHostInput(PipelineElement):
+    """Same violation as UndeclaredHostInput, but the comment escape
+    hatch suppresses it -- must NOT be flagged."""
+
+    def process_frame(self, stream, image=None):
+        data = np.asarray(image)    # aiko-lint: disable=undeclared-host-input
+        return True, {"n": int(data.size)}
+
+
+class CleanHead(PipelineElement):
+    """Violation-free head: passes frame data through."""
+
+    def process_frame(self, stream, image=None):
+        return True, {"image": image}
+
+
+class CleanSink(PipelineElement):
+    """Violation-free terminal sink (host-typed input declared)."""
+
+    host_inputs = ("n", "v", "out", "image", "y")
+
+    def process_frame(self, stream, **inputs):
+        return True, {}

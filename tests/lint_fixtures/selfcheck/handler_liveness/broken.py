@@ -1,0 +1,6 @@
+"""Attaches a handler to a hook nothing runs."""
+
+
+class GhostHandler:
+    def attach(self, handler):
+        self.add_hook_handler("engine.ghost:0", handler)

@@ -1,0 +1,6 @@
+"""Registers a hook nothing ever runs."""
+
+
+class DeadHook:
+    def __init__(self):
+        self.add_hook("engine.dead:0")

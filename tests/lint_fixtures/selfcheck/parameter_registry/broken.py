@@ -1,0 +1,6 @@
+"""Reads a pipeline parameter the registry does not know about."""
+
+
+class Knobs:
+    def read(self):
+        return self._pipeline_parameters.get("mystery_knob")

@@ -1,0 +1,6 @@
+"""Resume post that carries the Frame but not its replay_epoch."""
+
+
+class Parker:
+    def park(self, frame):
+        self.post_self("resume_element", [frame])
