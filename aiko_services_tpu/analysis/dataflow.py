@@ -118,6 +118,13 @@ def analyze_dataflow(definition) -> list:
         problem = placement_error(block)
         if problem is not None:
             add("bad-placement", problem, spot, element.name)
+        elif "replicas" in block and "mesh" not in block \
+                and "devices" not in block:
+            add("replicas-on-unplaced",
+                f"element {element.name!r} declares "
+                f"replicas={block['replicas']!r} but no mesh/devices "
+                f"-- the stage is unplaced, so the replica group "
+                f"never forms", spot, element.name)
 
     # -- fallback signature parity --------------------------------------
     for element in definition.elements:

@@ -76,6 +76,11 @@ RULES: dict[str, tuple[str, str]] = {
                          "placement block on a remote-deployed element "
                          "-- a remote stage head can never be a local "
                          "admission boundary"),
+    "replicas-on-unplaced": (WARNING,
+                             "placement declares replicas but neither "
+                             "mesh nor devices -- nothing is placed, "
+                             "so no replica submesh can be carved and "
+                             "the group never forms"),
     "bad-parameter": (ERROR,
                       "pipeline parameter value outside its domain "
                       "(unknown enum choice, negative count/deadline, "

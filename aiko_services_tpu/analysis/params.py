@@ -65,6 +65,15 @@ PIPELINE_PARAMETERS: dict[str, ParamSpec] = {
     "replay_limit": ParamSpec(
         "replays per frame across device replacements (0 = unbounded)",
         number=True, minimum=0),
+    "replica_rebuild_ms": ParamSpec(
+        "delay before the background rebuild of a failed replica "
+        "(0 = no automatic rebuild)", number=True, minimum=0),
+    "replica_canary": ParamSpec(
+        "rebuilt replicas re-admit half-open behind one canary frame",
+        choices=("on", "off", "true", "false", "0", "1")),
+    "replica_autoscale_interval": ParamSpec(
+        "replica control-loop tick in seconds (absent/0 = off)",
+        number=True, minimum=0),
     "remote_retry_limit": ParamSpec(
         "undiscovered-remote retries before the frame errors "
         "(0 = forever)", number=True, minimum=0),

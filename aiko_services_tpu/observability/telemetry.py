@@ -302,6 +302,19 @@ class PipelineTelemetry:
             value for name, labels, value in self.registry.counters()}
         result["traces"] = {"buffered": len(self.traces),
                             "completed": self.traces.completed}
+        # Replicated stages (ISSUE 7): slot states + per-replica
+        # in-flight/occupancy, flattened as telemetry.replicas.* on
+        # the dashboard next to the failover/rebuild share counters.
+        try:
+            replicas = self.pipeline.replica_stats()
+        except Exception:
+            replicas = {}
+        if replicas:
+            result["replicas"] = {
+                stage: {"states": entry.get("states", []),
+                        "active": entry.get("active", []),
+                        "occupancy": entry.get("occupancy", [])}
+                for stage, entry in replicas.get("stages", {}).items()}
         return result
 
     def publish(self, force: bool = False) -> None:
@@ -351,6 +364,21 @@ class PipelineTelemetry:
                                stage=stage)
                 registry.gauge("stage_queue_depth", entry["waiting"],
                                stage=stage)
+            # Replicated stages (ISSUE 7): per-slot state (1 live /
+            # 0.5 half-open / 0 dead), in-flight depth and occupancy
+            # -- the scrape-side view of peer-shedding failover and
+            # the signals the autoscale control loop acts on.
+            for stage, group in pipeline.stage_scheduler.groups.items():
+                for index, state in enumerate(group.states):
+                    value = {"live": 1.0, "half_open": 0.5}.get(state,
+                                                                0.0)
+                    labels = {"stage": stage, "replica": str(index)}
+                    registry.gauge("replica_state", value, **labels)
+                    registry.gauge("replica_inflight",
+                                   group.active[index], **labels)
+                    registry.gauge("replica_occupancy",
+                                   round(group.occupancy(index), 4),
+                                   **labels)
         registry.gauge("traces_buffered", len(self.traces))
         registry.gauge("traces_completed", self.traces.completed)
         return registry.render_text()

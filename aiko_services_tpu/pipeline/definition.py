@@ -123,11 +123,49 @@ def _parse_lint(value, path: str) -> tuple:
     return tuple(value)
 
 
+def _replicas_error(spec) -> str | None:
+    """Why a placement ``replicas`` spec is malformed, or None.  Domain:
+    a count (int >= 1), ``"auto"`` (the control loop scales 1..pool),
+    or ``{"min": lo, "max": hi}`` autoscale bounds with 1 <= lo <= hi."""
+    if isinstance(spec, bool):
+        return f"replicas must be a count >= 1, 'auto' or " \
+               f"{{min, max}}, got {spec!r}"
+    if isinstance(spec, int):
+        if spec < 1:
+            return f"replicas must be >= 1, got {spec}"
+        return None
+    if isinstance(spec, str):
+        if spec.strip().lower() != "auto":
+            return f"replicas must be a count >= 1, 'auto' or " \
+                   f"{{min, max}}, got {spec!r}"
+        return None
+    if isinstance(spec, dict):
+        if not set(spec) <= {"min", "max"}:
+            return f"replicas bounds accept only min/max, " \
+                   f"got {sorted(spec)}"
+        low, high = spec.get("min", 1), spec.get("max")
+        for name, value in (("min", low), ("max", high)):
+            if value is not None and (not isinstance(value, int)
+                                      or isinstance(value, bool)
+                                      or value < 1):
+                return f"replicas {name} must be an int >= 1, " \
+                       f"got {value!r}"
+        if high is not None and low > high:
+            return f"replicas min ({low}) must be <= max ({high})"
+        return None
+    return f"replicas must be a count >= 1, 'auto' or {{min, max}}, " \
+           f"got {spec!r}"
+
+
 def placement_error(block: dict) -> str | None:
     """Why this placement block is malformed, or None.  The ONE
     authority shared by ``Pipeline._build_placement`` (create-time
     raise) and the dataflow analyzer's ``bad-placement`` rule, so the
     two can never drift."""
+    if "replicas" in block:
+        problem = _replicas_error(block["replicas"])
+        if problem:
+            return problem
     if "mesh" in block:
         mesh = block["mesh"]
         if not isinstance(mesh, dict) or not mesh or not all(
@@ -146,6 +184,11 @@ def placement_error(block: dict) -> str | None:
                 or want <= 0:
             return (f"placement devices must be a positive chip "
                     f"count or 'auto', got {want!r}")
+        return None
+    if "replicas" in block:
+        # ``replicas`` without mesh/devices places nothing -- legal at
+        # create (the ``replicas-on-unplaced`` lint rule warns), so a
+        # definition can declare bounds before committing chips.
         return None
     return f"placement needs 'mesh' or 'devices', got {sorted(block)}"
 

@@ -425,10 +425,12 @@ class FusedSegment:
         donate = {key: resolved[key] for key in donated}
         return keep, donate
 
-    def would_compile(self, resolved: dict, donated: set) -> bool:
+    def would_compile(self, resolved: dict, donated: set,
+                      replica: int | None = None) -> bool:
         keep, donate = self._split(resolved, donated)
         return self.jit_cache.probe(self._traced_fn,
-                                    (keep, donate, self._captures))
+                                    (keep, donate, self._captures),
+                                    context=replica)
 
     def poison(self, reason: str) -> None:
         """Mark this segment broken: the cached plan splices its members
@@ -437,14 +439,22 @@ class FusedSegment:
         self.broken = True
         _logger.warning("segment %s poisoned: %s", self.name, reason)
 
-    def call(self, resolved: dict, donated: set) -> dict:
+    def call(self, resolved: dict, donated: set,
+             replica: int | None = None) -> dict:
         """ONE device dispatch for the whole segment.  Returns the trace
-        outputs dict keyed ``element.name``."""
+        outputs dict keyed ``element.name``.
+
+        ``replica`` keys the segment's JitCache per replica submesh of
+        a replicated stage (ISSUE 7): jax re-specializes executables
+        per sharding, so replica A's warm signature is still a cold
+        compile on replica B -- the cache context keeps hit/miss and
+        the compile probe honest per replica."""
         keep, donate = self._split(resolved, donated)
         self.calls += 1
         start = time.perf_counter()
         try:
-            return self._call(keep, donate, self._captures)
+            return self._call(keep, donate, self._captures,
+                              _cache_context=replica)
         finally:
             elapsed_ms = (time.perf_counter() - start) * 1000.0
             with self._dispatch_lock:

@@ -185,6 +185,7 @@ class DeviceWindow:
         self._inflight: deque = deque()      # (frame_id, device leaves)
         self.noted = 0                       # frames entering the window
         self.synced = 0                      # frames paced to completion
+        self.invalidated = 0                 # entries dropped on dead chips
 
     def note(self, frame_id: int, swag) -> None:
         """Register a completed frame's outstanding device work."""
@@ -221,7 +222,9 @@ class DeviceWindow:
 
     def invalidate(self, failed: set) -> int:
         """Forget noted frames whose outstanding leaves sit on dead
-        chips (device replacement): ``pace`` would otherwise
+        chips (device replacement OR a single replica's failover --
+        ``failed`` is device-keyed, so retiring one replica submesh
+        never touches a peer's entries): ``pace`` would otherwise
         ``block_until_ready`` a buffer whose device no longer exists --
         a raise at best, a hang at worst.  Returns how many noted
         frames were dropped."""
@@ -233,6 +236,7 @@ class DeviceWindow:
                 keep.append((frame_id, leaves))
         if dropped:
             self._inflight = deque(keep)
+            self.invalidated += dropped
         return dropped
 
     @property
@@ -242,4 +246,4 @@ class DeviceWindow:
     @property
     def stats(self) -> dict:
         return {"outstanding": self.outstanding, "noted": self.noted,
-                "synced": self.synced}
+                "synced": self.synced, "invalidated": self.invalidated}

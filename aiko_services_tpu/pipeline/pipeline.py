@@ -86,6 +86,15 @@ BREAKER_THRESHOLD_DEFAULT = 3
 BREAKER_COOLDOWN_MS_DEFAULT = 1000.0
 OVERLOAD_POLICIES = ("block", "shed_oldest", "shed_newest")
 OVERLOAD_LIMIT_DEFAULT = 8
+# Replicated stages (ISSUE 7): delay before the background rebuild of
+# a dropped replica (``replica_rebuild_ms`` parameter, 0 = no automatic
+# rebuild -- operator/autoscaler drives it), and the occupancy
+# thresholds the ``replicas: auto`` control loop scales on (scale up
+# the stage whose admission queue grows while its live replicas are
+# busy; scale down the stage that idles).
+REPLICA_REBUILD_MS_DEFAULT = 200.0
+REPLICA_SCALE_UP_OCCUPANCY = 0.75
+REPLICA_SCALE_DOWN_OCCUPANCY = 0.25
 
 # Stage-worker threads (pipeline/stages.py) run elements off the event
 # loop; ``get_parameter`` resolution reaches the owning stream through
@@ -160,8 +169,16 @@ class Pipeline(Actor):
         # cache is wired once per process, env-gated.
         self.fused_segments: list[FusedSegment] = []
         setup_compilation_cache(definition.parameters)
+        # Replicated stages (ISSUE 7): stage -> (min, max) autoscale
+        # bounds resolved from the placement blocks' ``replicas`` specs
+        # (int N -> (N, N); "auto" -> (1, pool); {min, max} as given).
+        self._replica_bounds: dict[str, tuple[int, int]] = {}
         self.stage_placement = self._build_placement()
         self.stage_scheduler = self._build_stage_scheduler()
+        self._replica_failovers = 0
+        self._replica_rebuilds = 0
+        self.share["replica_failovers"] = 0
+        self.share["replica_rebuilds"] = 0
         self.graph = self._build_graph()
         self.share["element_count"] = len(self.graph)
         self.share["streams"] = 0
@@ -194,6 +211,7 @@ class Pipeline(Actor):
         self.add_hook("pipeline.process_stage_post:0")
         self.add_hook("pipeline.stage_hop:0")
         self.add_hook("pipeline.replacement:0")
+        self.add_hook("pipeline.replica_failover:0")
 
         # Telemetry plane (observability/): latency histograms, frame
         # traces and the export surface, fed by the hooks above.
@@ -221,6 +239,16 @@ class Pipeline(Actor):
         if interval and self.stage_placement is not None:
             self._health_timer = self.runtime.engine.add_timer_handler(
                 self.check_device_health, float(interval))
+        # Replica autoscale control loop (ISSUE 7): re-splits replica
+        # counts from queue depth + per-replica occupancy, bounded by
+        # the declared {min, max}; 0/absent = no periodic loop (the
+        # ``autoscale_replicas`` method stays callable).
+        self._autoscale_timer = None
+        autoscale = parse_number(self.definition.parameters.get(
+            "replica_autoscale_interval"), 0.0)
+        if autoscale and self._has_elastic_replicas():
+            self._autoscale_timer = self.runtime.engine.add_timer_handler(
+                self.autoscale_replicas, float(autoscale))
 
         fault_plan = definition.parameters.get("fault_plan")
         if fault_plan:
@@ -241,6 +269,7 @@ class Pipeline(Actor):
         (the TPU analogue of the reference's remote-process deploy,
         reference pipeline.py:246-258)."""
         stages = {}
+        replica_specs = {}
         for element_def in self.definition.elements:
             block = element_def.placement
             if not block:
@@ -255,27 +284,59 @@ class Pipeline(Actor):
                     f"{element_def.name}.placement: {problem}")
             if "mesh" in block:
                 stages[element_def.name] = dict(block["mesh"])
-            else:
+            elif "devices" in block:
                 want = block["devices"]
                 # ``devices: auto`` splits the pool proportionally to
                 # measured per-stage cost (StagePlacement._resolve);
                 # equal split until profiles exist.
                 stages[element_def.name] = "auto" \
                     if isinstance(want, str) else int(want)
+            else:
+                # ``{"replicas": N}`` alone places nothing -- the
+                # ``replicas-on-unplaced`` lint rule warns at create.
+                continue
+            if "replicas" in block:
+                replica_specs[element_def.name] = block["replicas"]
         if not stages:
             return None
         from .tensor import StagePlacement
         placement = StagePlacement()
-        placement.assign(stages)
+        replicas, replica_min = {}, {}
+        pool = len(placement.devices)
+        for name, spec in replica_specs.items():
+            low, high = self._replica_spec_bounds(spec, pool)
+            self._replica_bounds[name] = (low, high)
+            replica_min[name] = low
+            # Start at the floor; the control loop (and reassign after
+            # recovery) grows toward the max as load demands.
+            replicas[name] = low if low < high else high
+        placement.assign(stages, replicas=replicas or None,
+                         replica_min=replica_min or None)
         return placement
+
+    @staticmethod
+    def _replica_spec_bounds(spec, pool: int) -> tuple[int, int]:
+        """A placement ``replicas`` spec -> (min, max) counts: int N is
+        fixed at N, ``auto`` scales 1..pool, {min, max} as declared."""
+        if isinstance(spec, str):
+            return 1, max(1, pool)
+        if isinstance(spec, dict):
+            low = max(1, int(spec.get("min", 1)))
+            high = int(spec.get("max", pool))
+            return low, max(low, high)
+        count = max(1, int(spec))
+        return count, count
 
     def _build_stage_scheduler(self):
         """Stage-parallel execution (pipeline/stages.py): on for
         multi-stage placed pipelines unless ``stage_pipeline: off``.
         Single-stage placements have nothing to overlap with, so the
-        per-element path stays exactly as before."""
+        per-element path stays exactly as before -- UNLESS the stage is
+        replicated, whose frame-level data parallelism needs the
+        per-replica workers and admission windows."""
         if self.stage_placement is None \
-                or len(self.stage_placement.plans) < 2:
+                or (len(self.stage_placement.plans) < 2
+                    and not self.stage_placement.has_replicas):
             return None
         mode = str(self.definition.parameters.get(
             "stage_pipeline", "auto")).strip().lower()
@@ -288,25 +349,41 @@ class Pipeline(Actor):
         depth = int(parse_number(
             self.definition.parameters.get("stage_inflight"),
             STAGE_INFLIGHT_DEFAULT))
-        return StageScheduler(list(self.stage_placement.plans), depth)
+        placement = self.stage_placement
+        replicas = {stage: len(plans) for stage, plans
+                    in placement.replica_plans.items()}
+        return StageScheduler(list(placement.plans), depth,
+                              replicas=replicas or None)
 
     def _cancel_health_timer(self):
         if self._health_timer is not None:
             self.runtime.engine.remove_timer_handler(self._health_timer)
             self._health_timer = None
+        if self._autoscale_timer is not None:
+            self.runtime.engine.remove_timer_handler(
+                self._autoscale_timer)
+            self._autoscale_timer = None
 
-    def check_device_health(self, prober=None, timeout=None) -> list:
-        """Probe the placement's devices; on failure, re-place stages on
-        the survivors (SURVEY.md §5.3 TPU-equiv: chip health checks +
-        stage re-placement).  Returns the failed devices (empty when all
-        healthy or no placement).  Schedule periodically via the
-        ``health_check_interval`` pipeline parameter (seconds); probe
-        deadline from ``timeout`` or the ``health_probe_timeout``
-        pipeline parameter (seconds, default tpu/health.PROBE_TIMEOUT).
+    def check_device_health(self, prober=None, timeout=None,
+                            devices=None) -> list:
+        """Probe the placement's devices (or just ``devices`` -- the
+        replica-scoped probe a replicated stage's dispatch error runs,
+        so one replica's probe timeout can never mark a healthy peer's
+        chips suspect); on failure, recover (SURVEY.md §5.3 TPU-equiv:
+        chip health checks + stage re-placement).  Failures confined to
+        replicas of replicated stages take the cheap path --
+        ``fail_replica`` sheds the dead replica's frames to its peers
+        and the group keeps serving at N-1 -- anything wider pays for
+        the full ``replace_failed_devices`` rebuild.  Returns the
+        failed devices (empty when all healthy or no placement).
+        Schedule periodically via the ``health_check_interval``
+        pipeline parameter (seconds); probe deadline from ``timeout``
+        or the ``health_probe_timeout`` pipeline parameter (seconds,
+        default tpu/health.PROBE_TIMEOUT).
 
         An armed FaultPlan's ``device_kill``/``device_hang`` rules wrap
         the prober here -- the swappable-prober injection point, so
-        chaos exercises the genuine probe -> replace -> replay path."""
+        chaos exercises the genuine probe -> recover -> replay path."""
         if self.stage_placement is None:
             return []
         from ..tpu.health import probe_devices
@@ -315,11 +392,55 @@ class Pipeline(Actor):
                 self.get_pipeline_parameter("health_probe_timeout"), None)
         if self._faults is not None:
             prober = self._fault_prober(prober)
-        failed = probe_devices(self.stage_placement.devices, prober,
-                               timeout=timeout)
+        pool = self.stage_placement.devices if devices is None \
+            else list(devices)
+        failed = probe_devices(pool, prober, timeout=timeout)
         if failed:
-            self.replace_failed_devices(failed)
+            # One victim at a time, RE-RESOLVED between kills: a
+            # failover can escalate (all-dead rebuild reassigns, the
+            # scheduler-less path full-replaces), which re-carves the
+            # pool and invalidates every other victim's slot index --
+            # an index resolved before the escalation would retire
+            # healthy chips and leave the real dead ones placed.
+            remaining = set(failed)
+            while remaining:
+                victims = self._replica_victims(remaining)
+                if victims is None:
+                    self.replace_failed_devices(remaining)
+                    break
+                stage, index = victims[0]
+                dead = self.stage_placement.replica_devices(stage,
+                                                            index)
+                self.fail_replica(stage, index)
+                if not dead or not (remaining - dead):
+                    break
+                remaining -= dead
         return failed
+
+    def _replica_victims(self, failed) -> list[tuple] | None:
+        """(stage, replica) slots covering EVERY failed device, or None
+        when any failure falls outside a live replica of a replicated
+        stage (the full-replace path must handle it) -- including when
+        there is no stage scheduler (``stage_pipeline: off``): without
+        replica admission there is no peer-shed path, so the full
+        rebuild is the only recovery.  A failure spanning several
+        replicas fails each -- still cheaper than stopping the world."""
+        placement = self.stage_placement
+        if placement is None or not placement.has_replicas \
+                or self.stage_scheduler is None:
+            return None
+        victims = []
+        covered = set()
+        for stage in placement.replica_plans:
+            for index in placement.live_replicas(stage):
+                devices = placement.replica_devices(stage, index)
+                hit = devices & set(failed)
+                if hit:
+                    victims.append((stage, index))
+                    covered |= devices
+        if not victims or set(failed) - covered:
+            return None
+        return victims
 
     def replace_failed_devices(self, failed_devices) -> None:
         """Shrink/re-place every placed stage onto surviving devices and
@@ -376,6 +497,11 @@ class Pipeline(Actor):
                 if self._replay_frame(stream, frame, failed_set,
                                       replay_limit):
                     replayed += 1
+        # Replica groups track the re-placed counts (a shrunk pool may
+        # have shed replicas); everything is freshly carved, so every
+        # surviving slot re-admits live -- the canary discipline is for
+        # the targeted rebuild path, not the stop-the-world one.
+        self._reset_replica_groups()
         self.run_hook("pipeline.replacement:0",
                       lambda: {"failed": [str(d) for d in failed_devices],
                                "generation": placement.generation,
@@ -384,6 +510,312 @@ class Pipeline(Actor):
                                           for name, plan
                                           in placement.plans.items()}})
         self.ec_producer.update("replacements", placement.generation)
+
+    # -- replicated stages: failover / rebuild / autoscale (ISSUE 7) -------
+
+    def _reset_replica_groups(self, half_open: dict | None = None) -> None:
+        """Sync the scheduler's ReplicaGroups to the placement's
+        current replica counts (after replace/reassign); ``half_open``
+        maps stage -> iterable of slot indices that must re-admit
+        behind a canary frame."""
+        scheduler = self.stage_scheduler
+        placement = self.stage_placement
+        if scheduler is None or placement is None:
+            return
+        for stage, group in scheduler.groups.items():
+            count = placement.replica_total(stage)
+            if count:
+                group.rebuild(count, (half_open or {}).get(stage, ()))
+
+    def current_replica(self) -> tuple | None:
+        """(stage, replica index) while a stage worker executes an
+        element/segment for a specific replica submesh (thread-local,
+        like ``current_stream``); None on the event loop and on
+        unreplicated stages.  ``TPUElement.plan`` keys off it."""
+        return getattr(_THREAD_STREAM, "replica", None)
+
+    def fail_replica(self, stage: str, index: int) -> None:
+        """Peer-shedding failover: retire ONE dead replica and keep the
+        stage serving at N-1.  The dead slot's chips leave the pool, its
+        in-flight frames drain to the surviving peers via the replay
+        path (last host-visible boundary, ``replay_epoch`` voiding
+        stale posts, undiscovered-remote backoff reset -- a frame
+        punished for a dead replica's failures starts clean on a
+        healthy one), and NOTHING else is touched: no other submesh
+        rebuilds, no peer frame replays, generation unchanged.
+        ``replace()``-style rebuild runs in the background after
+        ``replica_rebuild_ms`` (0 disables)."""
+        placement = self.stage_placement
+        scheduler = self.stage_scheduler
+        if placement is None:
+            return
+        if scheduler is None:
+            # ``stage_pipeline: off`` with replicas declared: there is
+            # no replica admission to shed through, but the chips are
+            # still dead -- pay for the full rebuild rather than
+            # silently leaving a dead submesh in the pool.
+            dead = placement.replica_devices(stage, index)
+            if dead:
+                self.replace_failed_devices(dead)
+            return
+        start = time.perf_counter()
+        dead = placement.drop_replica(stage, index)
+        if not dead:
+            return                      # unknown/already-dead slot
+        # Elements whose CACHED whole-pool/shared-submesh plan spans the
+        # retired chips must re-resolve (default-placed elements span
+        # every local device; the replicated stage's own whole-stage
+        # plan shrank) -- peers placed on their own submeshes keep
+        # their plans and compiled functions untouched.
+        from .tensor import TPUElement
+        for node in self.graph.nodes():
+            element = node.element
+            if isinstance(element, TPUElement) \
+                    and element._plan is not None \
+                    and dead & set(element._plan.mesh.devices.flat):
+                element.on_replacement()
+        group = scheduler.groups.get(stage)
+        if group is not None:
+            group.fail(index)
+        self.logger.warning(
+            "stage %s replica %d failed: shedding to %d peer(s), "
+            "%d chip(s) retired", stage, index,
+            group.live() if group is not None else 0, len(dead))
+        replay_limit = int(parse_number(
+            self.get_pipeline_parameter("replay_limit"),
+            REPLAY_LIMIT_DEFAULT))
+        replayed = 0
+        invalidated = 0
+        for stream in list(self.streams.values()):
+            invalidated += stream.device_window.invalidate(dead)
+            for frame in list(stream.frames.values()):
+                mine = frame.stage == stage and frame.stage_replica == index
+                if not mine and not touches_devices(frame.swag, dead):
+                    continue
+                frame.remote_retries = 0    # fresh backoff on the peer
+                frame.metrics.pop("remote_retries", None)
+                if self._replay_frame(stream, frame, dead, replay_limit):
+                    replayed += 1
+        self._replica_failovers += 1
+        self.share["replica_failovers"] = self._replica_failovers
+        failover_ms = (time.perf_counter() - start) * 1000.0
+        self.share["replica_failover_ms"] = round(failover_ms, 3)
+        if self.telemetry is not None:
+            self.telemetry.registry.count("replica_failovers",
+                                          stage=stage)
+        self.run_hook("pipeline.replica_failover:0",
+                      lambda: {"stage": stage, "replica": index,
+                               "failed": [str(d) for d in dead],
+                               "live": group.live()
+                               if group is not None else 0,
+                               "replayed": replayed,
+                               "window_invalidated": invalidated,
+                               "ms": failover_ms})
+        if group is not None and group.all_dead():
+            # No peers left to shed to: the stage cannot serve at all.
+            # Escalate to the full rebuild immediately (it re-fits the
+            # ORIGINAL requests to the surviving pool).
+            self.rebuild_replica(stage)
+            return
+        rebuild_ms = float(parse_number(
+            self.get_pipeline_parameter("replica_rebuild_ms"),
+            REPLICA_REBUILD_MS_DEFAULT))
+        if rebuild_ms > 0:
+            self.post_self("rebuild_replica", [stage],
+                           delay=rebuild_ms / 1000.0)
+
+    def rebuild_replica(self, stage: str) -> None:
+        """Background rebuild after a failover: re-fit the ORIGINAL
+        stage requests (desired replica counts included) onto the
+        surviving pool and re-carve.  Every in-flight frame on rebuilt
+        submeshes replays (same invalidation as ``replace()``); the
+        restored slots of ``stage`` re-admit HALF-OPEN -- one canary
+        frame each, breaker-style, before full re-admission
+        (``replica_canary: off`` skips the canary)."""
+        placement = self.stage_placement
+        if placement is None or stage not in placement.replica_plans:
+            return
+        dead_slots = [idx for idx, plan
+                      in enumerate(placement.replica_plans[stage])
+                      if plan is None]
+        if not dead_slots:
+            # Nothing left to restore (an earlier rebuild/reassign beat
+            # this post here): a reassign now would bump the generation
+            # and replay every in-flight frame for nothing.
+            return
+        try:
+            placement.reassign()
+        except (RuntimeError, ValueError) as error:
+            self.logger.error("replica rebuild for %s impossible: %s",
+                              stage, error)
+            return
+        canary = str(self.get_pipeline_parameter(
+            "replica_canary", "on")).strip().lower() \
+            not in ("off", "false", "0")
+        restored = [idx for idx in dead_slots
+                    if idx < placement.replica_total(stage)]
+        self._invalidate_after_reassign()
+        self._reset_replica_groups(
+            half_open={stage: restored} if canary else None)
+        self._replica_rebuilds += 1
+        self.share["replica_rebuilds"] = self._replica_rebuilds
+        if self.telemetry is not None:
+            self.telemetry.registry.count("replica_rebuilds",
+                                          stage=stage)
+        self.logger.warning(
+            "stage %s rebuilt: %d replica slot(s) restored%s "
+            "(generation %d)", stage, len(restored),
+            " half-open behind a canary" if canary and restored else "",
+            placement.generation)
+        self.ec_producer.update("replacements", placement.generation)
+
+    def _invalidate_after_reassign(self) -> None:
+        """Post-reassign invalidation, shared by rebuild and autoscale:
+        every stage was re-carved, so plans, fused segments and
+        in-flight frames are all stale -- exactly the
+        ``replace_failed_devices`` discipline minus the dead-device
+        scrubbing (no chips died here) -- and minus the replay-budget
+        charge: an administrative re-carve must not consume the frames'
+        failure-recovery allowance (``count=False``)."""
+        from .tensor import TPUElement
+
+        for node in self.graph.nodes():
+            element = node.element
+            if isinstance(element, TPUElement):
+                element.on_replacement()
+        for stream in self.streams.values():
+            stream.fusion_plans.clear()
+            stream.fusion_segments.clear()
+        self.fused_segments.clear()
+        for stream in list(self.streams.values()):
+            for frame in list(stream.frames.values()):
+                self._replay_frame(stream, frame, set(), 0, count=False)
+
+    def _has_elastic_replicas(self) -> bool:
+        return any(low < high
+                   for low, high in self._replica_bounds.values())
+
+    def autoscale_replicas(self) -> dict:
+        """One control-loop tick: scale UP the replicated stage whose
+        admission queue grows while its live replicas run hot
+        (occupancy >= REPLICA_SCALE_UP_OCCUPANCY), scale DOWN the one
+        idling (every replica under REPLICA_SCALE_DOWN_OCCUPANCY, no
+        queue), one step per tick, bounded by the declared {min, max}.
+        Applies via ``set_replicas`` + ``reassign`` and returns the
+        {stage: new count} decisions (empty = no change).  Runs
+        periodically under ``replica_autoscale_interval``; callable
+        directly (bench, operators)."""
+        placement = self.stage_placement
+        scheduler = self.stage_scheduler
+        if placement is None or scheduler is None:
+            return {}
+        decisions: dict[str, int] = {}
+        for stage, (low, high) in self._replica_bounds.items():
+            if low >= high:
+                continue
+            group = scheduler.groups.get(stage)
+            if group is None:
+                continue
+            live = group.live()
+            occupancies = [group.occupancy(idx)
+                           for idx, state in enumerate(group.states)
+                           if state == "live"]
+            busiest = max(occupancies, default=0.0)
+            # The signal consumed, start the next tick's window fresh:
+            # occupancy must describe THIS interval's load, not dilute
+            # under the idle time since creation (construction +
+            # first-compile alone would hold it under threshold for
+            # many multiples of the tick).
+            group.reset_window()
+            if scheduler.waiting(stage) > 0 and live < high \
+                    and busiest >= REPLICA_SCALE_UP_OCCUPANCY:
+                decisions[stage] = live + 1
+            elif live > low and scheduler.waiting(stage) == 0 \
+                    and busiest <= REPLICA_SCALE_DOWN_OCCUPANCY:
+                decisions[stage] = live - 1
+        # Capacity gate for fixed-request scale-ups: without free chips
+        # (or a simultaneous scale-down freeing some) the reassign
+        # would shed the increment straight back -- a no-op that still
+        # bumps the generation and replays every in-flight frame, every
+        # tick, for as long as the load lasts.  ``auto``-request stages
+        # re-split their existing allocation, so they pass freely.
+        ups = {stage: count for stage, count in decisions.items()
+               if count > scheduler.groups[stage].live()}
+        if ups:
+            allocated = sum(int(plan.mesh.devices.size)
+                            for plan in placement.plans.values())
+            free = len(placement.devices) - allocated
+            freed = 0
+            for stage, count in decisions.items():
+                if count < scheduler.groups[stage].live():
+                    sizes = [int(plan.mesh.devices.size) for plan
+                             in placement.replica_plans.get(stage, ())
+                             if plan is not None]
+                    freed += min(sizes, default=0)
+            for stage in ups:
+                if placement._requests.get(stage) == "auto":
+                    continue
+                sizes = [int(plan.mesh.devices.size) for plan
+                         in placement.replica_plans.get(stage, ())
+                         if plan is not None]
+                need = min(sizes, default=1)
+                if free + freed < need:
+                    del decisions[stage]
+        if not decisions:
+            return {}
+        rollback = {stage: placement._replica_desired[stage]
+                    for stage in decisions}
+        for stage, count in decisions.items():
+            placement.set_replicas(stage, count)
+        try:
+            placement.reassign()
+        except (RuntimeError, ValueError) as error:
+            # Restore the desired counts: leaving the phantom increment
+            # behind would let the NEXT replace/rebuild re-fit carve a
+            # replica this loop never reported deciding.
+            for stage, count in rollback.items():
+                placement.set_replicas(stage, count)
+            self.logger.error("replica autoscale reassign failed: %s",
+                              error)
+            return {}
+        self._invalidate_after_reassign()
+        self._reset_replica_groups()
+        for group in scheduler.groups.values():
+            group.reset_window()
+        self.logger.info("replica autoscale: %s (generation %d)",
+                         decisions, placement.generation)
+        if self.telemetry is not None:
+            for stage in decisions:
+                self.telemetry.registry.count("replica_autoscales",
+                                              stage=stage)
+        return decisions
+
+    def replica_stats(self) -> dict:
+        """Per-replicated-stage view the dashboard/bench read: slot
+        states, per-replica in-flight + occupancy, live count, bounds,
+        failover/rebuild counters."""
+        placement = self.stage_placement
+        scheduler = self.stage_scheduler
+        if placement is None or not placement.has_replicas:
+            return {}
+        result: dict = {"failovers": self._replica_failovers,
+                        "rebuilds": self._replica_rebuilds,
+                        "stages": {}}
+        failover_ms = self.share.get("replica_failover_ms")
+        if failover_ms is not None:
+            result["failover_ms"] = failover_ms
+        for stage, plans in placement.replica_plans.items():
+            entry = {"slots": [None if plan is None
+                               else int(plan.mesh.devices.size)
+                               for plan in plans],
+                     "bounds": list(self._replica_bounds.get(
+                         stage, (len(plans), len(plans))))}
+            if scheduler is not None:
+                group = scheduler.groups.get(stage)
+                if group is not None:
+                    entry.update(group.stats)
+            result["stages"][stage] = entry
+        return result
 
     def _build_graph(self) -> Graph:
         graph = Graph.traverse(self.definition.graph)
@@ -599,8 +1031,9 @@ class Pipeline(Actor):
 
     def _fault_target_devices(self, target) -> set:
         """Resolve a device-fault rule's target: a placed stage name
-        (its current submesh), ``device:<index>`` into the placement
-        pool, or None for every placed device."""
+        (its current submesh), ``stage#<replica>`` for ONE replica's
+        submesh of a replicated stage, ``device:<index>`` into the
+        placement pool, or None for every placed device."""
         placement = self.stage_placement
         if placement is None:
             return set()
@@ -609,6 +1042,13 @@ class Pipeline(Actor):
         target = str(target)
         if target in placement.plans:
             return placement.stage_devices(target)
+        if "#" in target:
+            stage, _, index = target.partition("#")
+            if stage in placement.replica_plans:
+                try:
+                    return placement.replica_devices(stage, int(index))
+                except (ValueError, IndexError):
+                    return set()
         if target.startswith("device:"):
             try:
                 return {placement.devices[int(target[7:])]}
@@ -685,11 +1125,25 @@ class Pipeline(Actor):
         stages and replayed (or error-bounded) every in-flight frame,
         THIS one included; the caller must then skip its own
         _frame_error.  Healthy probe -> False -> normal error path (a
-        code bug is not a chip loss)."""
+        code bug is not a chip loss).
+
+        On a replicated stage the probe is SCOPED to the frame's own
+        replica submesh (ISSUE 7): the dispatch raised there, so that
+        is where the evidence points -- and a hung chip's probe
+        timeout must never mark a healthy peer's chips suspect (the
+        periodic ``health_check_interval`` probe still walks the full
+        pool, so failures elsewhere are found on their own clock, not
+        blamed on this frame)."""
         if self.stage_placement is None:
             return False
+        scoped = None
+        if frame.stage is not None and frame.stage_replica is not None:
+            devices = self.stage_placement.replica_devices(
+                frame.stage, frame.stage_replica)
+            if devices:
+                scoped = list(devices)
         try:
-            failed = self.check_device_health()
+            failed = self.check_device_health(devices=scoped)
         except Exception:
             self.logger.exception("post-dispatch-error health check "
                                   "failed")
@@ -697,7 +1151,7 @@ class Pipeline(Actor):
         return bool(failed)
 
     def _replay_frame(self, stream: Stream, frame: Frame, failed: set,
-                      replay_limit: int) -> bool:
+                      replay_limit: int, count: bool = True) -> bool:
         """Re-admit one in-flight frame after a device replacement.
 
         The replay frontier is the frame's last host-visible boundary:
@@ -706,8 +1160,13 @@ class Pipeline(Actor):
         dead chips are fetched to host when still reachable (re-uploaded
         to the replacement submeshes by the replayed walk's normal
         hops/puts) or dropped.  Bounded by ``replay_limit`` per frame;
-        over it, the frame errors instead of looping.  Returns True when
-        the frame was scheduled for replay."""
+        over it, the frame errors instead of looping.  ``count=False``
+        is the ADMINISTRATIVE replay (autoscale re-split, background
+        replica rebuild): no chips failed, so the engine's own re-carve
+        must not consume the frame's failure-recovery budget -- under
+        sustained load consecutive scale-up ticks would otherwise error
+        the very backlog they exist to absorb.  Returns True when the
+        frame was scheduled for replay."""
         node = self.graph.get_node(frame.paused_pe_name) \
             if frame.paused_pe_name is not None \
             and frame.paused_pe_name in self.graph else None
@@ -716,22 +1175,27 @@ class Pipeline(Actor):
             # just scrub stranded swag so the resume survives.
             self._scrub_swag(frame, failed)
             return False
-        frame.replays += 1
-        if replay_limit and frame.replays > replay_limit:
-            # Per-frame failure: the over-budget FRAME errors; sibling
-            # frames still within budget keep their replays (and the
-            # stream) alive.
-            self._frame_fail(
-                stream, frame,
-                f"replay limit ({replay_limit}) exceeded after device "
-                f"replacement")
-            return False
+        if count:
+            frame.replays += 1
+            if replay_limit and frame.replays > replay_limit:
+                # Per-frame failure: the over-budget FRAME errors;
+                # sibling frames still within budget keep their replays
+                # (and the stream) alive.
+                self._frame_fail(
+                    stream, frame,
+                    f"replay limit ({replay_limit}) exceeded after "
+                    f"device replacement")
+                return False
         # Stale-ify every in-flight continuation of the PREVIOUS
         # attempt: worker/async completion posts carry the epoch they
         # were submitted under and are discarded on mismatch.
         frame.replay_epoch += 1
         frame.paused_pe_name = None
-        self._release_stage(stream, frame)
+        # ok=None: a replayed frame is yanked, not judged -- a
+        # half-open slot whose canary it was keeps waiting for a REAL
+        # verdict (unless this stage already completed, which
+        # _release_stage upgrades to success).
+        self._release_stage(stream, frame, ok=None)
         self._scrub_swag(frame, failed)
         resume_at = None
         for path_node in self._stream_path(stream):
@@ -1450,7 +1914,9 @@ class Pipeline(Actor):
                     hop_start = time.perf_counter()
                     inputs.update(self.stage_placement.transfer(
                         {name: value for name, value in inputs.items()
-                         if name not in host_typed}, node.name))
+                         if name not in host_typed}, node.name,
+                        replica=frame.stage_replica
+                        if frame.stage == node.name else None))
                     hop_ms = (time.perf_counter() - hop_start) * 1000.0
                     frame.metrics[f"{node.name}_hop_ms"] = hop_ms
                     self.run_hook("pipeline.stage_hop:0",
@@ -1605,7 +2071,9 @@ class Pipeline(Actor):
             return None
         donated = segment.donate_keys(resolved, frame.swag,
                                       frame.produced)
-        compiling = segment.would_compile(resolved, donated)
+        compiling = segment.would_compile(
+            resolved, donated,
+            replica=self._frame_replica_for(frame, segment))
         start = time.perf_counter()
         for node in segment.nodes:
             frame.metrics[f"{node.name}_time_start"] = start
@@ -1616,6 +2084,19 @@ class Pipeline(Actor):
                                "frame": frame.frame_id,
                                "compile": compiling})
         return resolved, donated, compiling, start
+
+    @staticmethod
+    def _frame_replica_for(frame: Frame, segment) -> int | None:
+        """The replica submesh a stage-local segment dispatch belongs
+        to: the frame's admitted replica when it holds the segment's
+        stage credit, else None.  Keys the segment's JitCache per
+        replica -- jax re-specializes executables per sharding, so
+        replica A's warm signature is still a cold compile on replica
+        B and the probe/poison logic must see it that way."""
+        if segment.stage_context is not None \
+                and frame.stage == segment.stage_context:
+            return frame.stage_replica
+        return None
 
     def _run_fused_segment(self, stream: Stream, frame: Frame,
                            segment: FusedSegment):
@@ -1642,13 +2123,15 @@ class Pipeline(Actor):
             if self._faults is not None:
                 self._inject_segment_fault(segment.name,
                                            stream.stream_id)
+            replica = self._frame_replica_for(frame, segment)
             if ledger.active:
                 # The whole segment is device-element event-loop work:
                 # one guard scope around the single dispatch.
                 with ledger.guard():
-                    out = segment.call(resolved, donated)
+                    out = segment.call(resolved, donated,
+                                       replica=replica)
             else:
-                out = segment.call(resolved, donated)
+                out = segment.call(resolved, donated, replica=replica)
         except Exception as error:
             if ledger.is_guard_error(error):
                 ledger.record_implicit()
@@ -1764,8 +2247,26 @@ class Pipeline(Actor):
             return
         scheduler = self.stage_scheduler
         if scheduler is not None and frame.stage != node_name:
-            if not scheduler.try_admit(node_name,
-                                       reserved=bool(from_queue)):
+            group = scheduler.groups.get(node_name)
+            if group is not None and group.all_dead():
+                # Every replica dead and no rebuild yet: failing the
+                # frame beats queueing it forever behind a stage that
+                # cannot admit.
+                if from_queue:
+                    scheduler.cancel_reservation(node_name)
+                self._frame_fail(stream, frame,
+                                 f"stage {node_name}: all replicas "
+                                 f"dead (awaiting rebuild)")
+                return
+            if group is not None:
+                replica = scheduler.admit_replica(
+                    node_name, reserved=bool(from_queue))
+                admitted = replica is not None
+            else:
+                replica = None
+                admitted = scheduler.try_admit(node_name,
+                                               reserved=bool(from_queue))
+            if not admitted:
                 scheduler.enqueue(node_name,
                                   [str(stream_id), int(frame_id),
                                    node_name, True, frame],
@@ -1774,6 +2275,9 @@ class Pipeline(Actor):
             frame.stage_waiting = None
             self._release_stage(stream, frame)
             frame.stage = node_name
+            frame.stage_replica = replica
+            if replica is not None:
+                frame.metrics[f"stage_{node_name}_replica"] = replica
             frame.stage_generation = \
                 self.stage_placement.generation \
                 if self.stage_placement is not None else 0
@@ -1802,8 +2306,8 @@ class Pipeline(Actor):
                                            target=node_name,
                                            stream=stream.stream_id)
                 if rule is not None:
-                    scheduler.executor(node_name).stall(
-                        rule.delay_ms / 1000.0)
+                    scheduler.executor(node_name, frame.stage_replica) \
+                        .stall(rule.delay_ms / 1000.0)
         if not self._resume_walk_at(stream, frame, node_name, fuse=True):
             self._frame_error(
                 stream, frame,
@@ -1823,11 +2327,26 @@ class Pipeline(Actor):
                 return True
         return False
 
-    def _release_stage(self, stream: Stream, frame: Frame) -> None:
+    def _release_stage(self, stream: Stream, frame: Frame,
+                       ok: bool | None = True) -> None:
         """Return the frame's stage credit (next-stage admission, async
         park, completion, error, stream teardown) and wake the next
-        queued frame."""
+        queued frame.  For a replicated stage the credit goes back to
+        the replica that admitted the frame, and ``ok`` carries the
+        canary verdict: a half-open slot's canary frame succeeding
+        closes the slot live, failing re-kills it, ``None`` (an
+        administrative replay) leaves it half-open awaiting a real
+        canary."""
         stage, frame.stage = frame.stage, None
+        replica, frame.stage_replica = frame.stage_replica, None
+        if ok is not True and stage is not None \
+                and stage in frame.completed:
+            # The frame failed AFTER this stage's head completed
+            # (deadline while queued downstream, a later stage's
+            # error): that is not this replica's verdict -- a half-open
+            # slot whose canary ran the stage successfully closes live
+            # even if the frame dies elsewhere.
+            ok = True
         # A released frame is no longer waiting anywhere: its queued
         # token (if any) must read as stale when popped.
         frame.stage_waiting = None
@@ -1843,7 +2362,8 @@ class Pipeline(Actor):
                                "frame": frame.frame_id,
                                "ms": frame.metrics.get(
                                    f"stage_{stage}_ms", 0.0)})
-        waiter = self.stage_scheduler.release(stage)
+        waiter = self.stage_scheduler.release(stage, replica=replica,
+                                              ok=ok)
         if waiter is not None:
             self.post_self("enter_stage_frame", list(waiter))
 
@@ -1866,6 +2386,7 @@ class Pipeline(Actor):
         frame.paused_pe_name = node.name
         stream_id, frame_id = stream.stream_id, frame.frame_id
         node_name = node.name
+        replica = frame.stage_replica   # replicated-stage submesh pick
         epoch = frame.replay_epoch      # stale after a replay
         submitted = time.perf_counter()
         frame.metrics[f"{node_name}_time_start"] = submitted
@@ -1877,6 +2398,10 @@ class Pipeline(Actor):
         def job():
             start = time.perf_counter()
             _THREAD_STREAM.stream = stream
+            # While this worker runs, ``self.plan`` on the stage's
+            # elements IS the replica's submesh (tensor.TPUElement).
+            _THREAD_STREAM.replica = None if replica is None \
+                else (node_name, replica)
             try:
                 if self._faults is not None:
                     self._inject_element_fault(node_name, stream_id)
@@ -1897,13 +2422,14 @@ class Pipeline(Actor):
                     {"diagnostic": str(error)}
             finally:
                 _THREAD_STREAM.stream = None
+                _THREAD_STREAM.replica = None
             self.post_self("resume_stage_frame",
                            [stream_id, frame_id, node_name, event,
                             outputs, start,
                             time.perf_counter() - start, submitted,
                             frame, epoch])
 
-        self.stage_scheduler.executor(node_name).submit(job)
+        self.stage_scheduler.executor(node_name, replica).submit(job)
 
     def resume_stage_frame(self, stream_id, frame_id, node_name, event,
                            outputs, exec_start, elapsed, submitted,
@@ -1943,27 +2469,33 @@ class Pipeline(Actor):
         resolved, donated, _compiling, _submitted = begun
         frame.paused_pe_name = segment.name
         stream_id, frame_id = stream.stream_id, frame.frame_id
+        replica = self._frame_replica_for(frame, segment)
         epoch = frame.replay_epoch      # stale after a replay
         ledger = self.transfer_ledger
 
         def job():
             start = time.perf_counter()
             _THREAD_STREAM.stream = stream
+            _THREAD_STREAM.replica = None if replica is None \
+                else (segment.stage_context, replica)
             out, diagnostic = None, ""
             # Re-probe on the worker, where this segment's dispatches
             # are serialized: the loop-side probe goes stale when an
             # earlier frame's job is still compiling this signature
             # (window depth >= 2), and a stale True would let a
             # transient data error permanently poison the segment.
-            compile_now = segment.would_compile(resolved, donated)
+            compile_now = segment.would_compile(resolved, donated,
+                                                replica=replica)
             try:
                 if self._faults is not None:
                     self._inject_segment_fault(segment.name, stream_id)
                 if ledger.active:
                     with ledger.guard():
-                        out = segment.call(resolved, donated)
+                        out = segment.call(resolved, donated,
+                                           replica=replica)
                 else:
-                    out = segment.call(resolved, donated)
+                    out = segment.call(resolved, donated,
+                                       replica=replica)
             except Exception as error:
                 if ledger.is_guard_error(error):
                     ledger.record_implicit()
@@ -1972,13 +2504,15 @@ class Pipeline(Actor):
                 diagnostic = str(error)
             finally:
                 _THREAD_STREAM.stream = None
+                _THREAD_STREAM.replica = None
             self.post_self("resume_stage_segment",
                            [stream_id, frame_id, segment, out,
                             diagnostic, resolved, donated, compile_now,
                             start, time.perf_counter() - start, frame,
                             epoch])
 
-        self.stage_scheduler.executor(segment.stage_context).submit(job)
+        self.stage_scheduler.executor(segment.stage_context,
+                                      replica).submit(job)
         return True
 
     def resume_stage_segment(self, stream_id, frame_id, segment, out,
@@ -2415,7 +2949,10 @@ class Pipeline(Actor):
     def _finish_failed_frame(self, stream: Stream, frame: Frame,
                              diagnostic: str):
         stream.frames.pop(frame.frame_id, None)
-        self._release_stage(stream, frame)
+        # ok=False: when the failed frame was a half-open replica's
+        # canary, its failure is the verdict -- the slot re-kills
+        # instead of re-admitting a replica that still cannot serve.
+        self._release_stage(stream, frame, ok=False)
         if self.telemetry is not None:
             self.telemetry.frame_finished(stream, frame, okay=False)
         if frame.delivery_seq is not None:

@@ -35,6 +35,14 @@ like a hardware pipeline:
   resettable window) feeds the ``stage_occupancy_*`` bench keys and the
   profiler's ``stage:`` spans -- the direct evidence that two stages
   ran concurrently.
+- :class:`ReplicaGroup` (ISSUE 7) generalizes the admission window for
+  **replicated stages** (``placement: {..., replicas: N}``): N
+  data-parallel replica submeshes each get their own credit window and
+  FIFO worker, frames round-robin across the live replicas, and the
+  reorder buffer merges completions back to ingest order.  A dead
+  replica stops admitting and its in-flight frames shed to the peers
+  (the engine's ``fail_replica`` replay path); a rebuilt replica
+  re-admits half-open behind a single canary frame, breaker-style.
 
 Scope note: stage credits are held in graph-path order and released
 forward, so admission is deadlock-free on acyclic paths.  A Loop element
@@ -58,10 +66,19 @@ from concurrent.futures import ThreadPoolExecutor
 
 from ..utils import get_logger
 
-__all__ = ["StageScheduler", "StageExecutor", "STAGE_INFLIGHT_DEFAULT",
-           "STAGE_PIPELINE_MODES"]
+__all__ = ["ReplicaGroup", "StageScheduler", "StageExecutor",
+           "STAGE_INFLIGHT_DEFAULT", "STAGE_PIPELINE_MODES",
+           "REPLICA_LIVE", "REPLICA_DEAD", "REPLICA_HALF_OPEN"]
 
 _logger = get_logger("aiko.stages")
+
+# Replica slot states (ISSUE 7).  ``half_open`` is the breaker-style
+# canary state a rebuilt replica re-admits through: exactly ONE frame
+# is admitted; its success closes the slot to ``live``, its failure
+# re-kills it.
+REPLICA_LIVE = "live"
+REPLICA_DEAD = "dead"
+REPLICA_HALF_OPEN = "half_open"
 
 # Default per-stage admission window (double buffering: one frame
 # executing on the stage's submesh, one hopping/queued behind it).
@@ -117,6 +134,171 @@ class StageExecutor:
         self._pool.shutdown(wait=False)
 
 
+class ReplicaGroup:
+    """Admission state for one replicated stage (ISSUE 7): a credit
+    window PER replica, round-robin admission across the live slots,
+    and the dead / half-open (canary) lifecycle the failover and
+    rebuild paths drive.
+
+    Owned by the event loop like the scheduler -- no locking.  The
+    group only decides WHICH replica admits a frame; the stage-level
+    FIFO wait queue, reservations and backpressure stay in
+    :class:`StageScheduler` (a queued frame wakes when ANY replica
+    frees a credit, so the queue cannot strand behind a dead slot)."""
+
+    def __init__(self, stage: str, count: int,
+                 depth: int = STAGE_INFLIGHT_DEFAULT):
+        self.stage = stage
+        self.depth = max(1, int(depth))
+        self.states: list[str] = [REPLICA_LIVE] * max(1, int(count))
+        self.active: list[int] = [0] * len(self.states)
+        self.admitted: list[int] = [0] * len(self.states)
+        self._rr = 0                    # round-robin cursor
+        self.failovers = 0
+        self.rebuilds = 0
+        self.canary_inflight: list[bool] = [False] * len(self.states)
+        # Per-replica busy-time integration (same windowed discipline
+        # as the scheduler's per-stage occupancy).
+        self._busy: list[float] = [0.0] * len(self.states)
+        self._busy_since: list[float | None] = [None] * len(self.states)
+        self._window_start = time.monotonic()
+        self.transitions: list[tuple] = []   # (slot, state, monotonic)
+
+    # -- admission ---------------------------------------------------------
+
+    def free_slots(self) -> int:
+        """Credits currently grantable across live slots (a half-open
+        slot counts at most its single canary)."""
+        free = 0
+        for index, state in enumerate(self.states):
+            if state == REPLICA_LIVE:
+                free += max(0, self.depth - self.active[index])
+            elif state == REPLICA_HALF_OPEN \
+                    and not self.canary_inflight[index] \
+                    and self.active[index] == 0:
+                free += 1
+        return free
+
+    def pick(self) -> int | None:
+        """Next replica to admit into (round-robin over live slots with
+        a free credit; a half-open slot admits exactly one canary), or
+        None when every slot is full/dead."""
+        count = len(self.states)
+        for offset in range(count):
+            index = (self._rr + offset) % count
+            state = self.states[index]
+            if state == REPLICA_LIVE \
+                    and self.active[index] < self.depth:
+                self._rr = index + 1
+                return index
+            if state == REPLICA_HALF_OPEN \
+                    and not self.canary_inflight[index] \
+                    and self.active[index] == 0:
+                self._rr = index + 1
+                return index
+        return None
+
+    def admit(self, index: int) -> None:
+        if self.states[index] == REPLICA_HALF_OPEN:
+            self.canary_inflight[index] = True
+        self.active[index] += 1
+        self.admitted[index] += 1
+        if self.active[index] == 1:
+            self._busy_since[index] = time.monotonic()
+
+    def release(self, index: int, ok: bool | None = True) -> None:
+        """Return a replica credit.  A half-open slot's canary outcome
+        decides its fate: success closes it live (full re-admission),
+        failure re-kills it.  ``ok=None`` is NO verdict (the canary
+        frame was yanked administratively -- replayed off a different
+        stage's failure -- before this stage could prove anything): the
+        slot stays half-open and the next admission is its canary."""
+        if index >= len(self.states):
+            return
+        if self.active[index] > 0:
+            self.active[index] -= 1
+            if self.active[index] == 0 \
+                    and self._busy_since[index] is not None:
+                self._busy[index] += \
+                    time.monotonic() - self._busy_since[index]
+                self._busy_since[index] = None
+        if self.states[index] == REPLICA_HALF_OPEN \
+                and self.canary_inflight[index]:
+            self.canary_inflight[index] = False
+            if ok is not None:
+                self._transition(index,
+                                 REPLICA_LIVE if ok else REPLICA_DEAD)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _transition(self, index: int, state: str) -> None:
+        self.states[index] = state
+        self.transitions.append((index, state, time.monotonic()))
+
+    def fail(self, index: int) -> None:
+        if index < len(self.states) \
+                and self.states[index] != REPLICA_DEAD:
+            self.failovers += 1
+            self.canary_inflight[index] = False
+            self._transition(index, REPLICA_DEAD)
+
+    def rebuild(self, count: int, half_open=()) -> None:
+        """Reset the group after a placement rebuild/re-split: every
+        slot becomes live except the ``half_open`` indices, which
+        re-admit behind a single canary frame each."""
+        half_open = set(half_open)
+        self.rebuilds += 1
+        self.states = [REPLICA_HALF_OPEN if index in half_open
+                       else REPLICA_LIVE
+                       for index in range(max(1, int(count)))]
+        self.active = [0] * len(self.states)
+        self.admitted = [0] * len(self.states)
+        self.canary_inflight = [False] * len(self.states)
+        self._busy = [0.0] * len(self.states)
+        self._busy_since = [None] * len(self.states)
+        self._rr = 0
+        for index in range(len(self.states)):
+            self.transitions.append(
+                (index, self.states[index], time.monotonic()))
+
+    def live(self) -> int:
+        return sum(1 for state in self.states if state == REPLICA_LIVE)
+
+    def all_dead(self) -> bool:
+        return all(state == REPLICA_DEAD for state in self.states)
+
+    # -- occupancy ---------------------------------------------------------
+
+    def reset_window(self) -> None:
+        now = time.monotonic()
+        for index in range(len(self.states)):
+            self._busy[index] = 0.0
+            if self._busy_since[index] is not None:
+                self._busy_since[index] = now
+        self._window_start = now
+
+    def occupancy(self, index: int) -> float:
+        wall = time.monotonic() - self._window_start
+        if wall <= 0 or index >= len(self._busy):
+            return 0.0
+        busy = self._busy[index]
+        if self._busy_since[index] is not None:
+            busy += time.monotonic() - self._busy_since[index]
+        return min(1.0, busy / wall)
+
+    @property
+    def stats(self) -> dict:
+        return {"states": list(self.states),
+                "active": list(self.active),
+                "admitted": list(self.admitted),
+                "live": self.live(),
+                "depth": self.depth,
+                "failovers": self.failovers,
+                "rebuilds": self.rebuilds,
+                "occupancy": [round(self.occupancy(index), 4)
+                              for index in range(len(self.states))]}
+
+
 class StageScheduler:
     """Credit-based per-stage admission + occupancy accounting.
 
@@ -126,9 +308,16 @@ class StageScheduler:
     triples the engine re-posts as ``enter_stage_frame`` continuations.
     """
 
-    def __init__(self, stages, depth: int = STAGE_INFLIGHT_DEFAULT):
+    def __init__(self, stages, depth: int = STAGE_INFLIGHT_DEFAULT,
+                 replicas: dict | None = None):
         self.depth = max(1, int(depth))
         self.stages = list(stages)
+        # Replicated stages (ISSUE 7): stage -> ReplicaGroup.  The
+        # group owns per-replica credits; the per-stage counters below
+        # keep tracking the TOTAL so occupancy/stats stay uniform.
+        self.groups: dict[str, ReplicaGroup] = {
+            stage: ReplicaGroup(stage, count, self.depth)
+            for stage, count in (replicas or {}).items()}
         self._active: dict[str, int] = {s: 0 for s in self.stages}
         self._waiters: dict[str, deque] = {s: deque() for s in self.stages}
         # Credits promised to POPPED waiter tokens whose resume posts
@@ -150,10 +339,17 @@ class StageScheduler:
 
     # -- workers -----------------------------------------------------------
 
-    def executor(self, stage: str) -> StageExecutor:
-        worker = self._executors.get(stage)
+    def executor(self, stage: str,
+                 replica: int | None = None) -> StageExecutor:
+        """The stage's FIFO worker -- or, for a replicated stage, the
+        worker of ONE replica (each replica serializes its own submesh
+        while peers run concurrently: that concurrency IS the dp-N
+        speedup)."""
+        key = stage if replica is None else (stage, replica)
+        worker = self._executors.get(key)
         if worker is None:
-            worker = self._executors[stage] = StageExecutor(stage)
+            name = stage if replica is None else f"{stage}#{replica}"
+            worker = self._executors[key] = StageExecutor(name)
         return worker
 
     # -- admission window --------------------------------------------------
@@ -171,11 +367,34 @@ class StageScheduler:
             return False
         if self._active.get(stage, 0) >= self.depth:
             return False
+        self._count_admit(stage)
+        return True
+
+    def _count_admit(self, stage: str) -> None:
         self._active[stage] = self._active.get(stage, 0) + 1
         self.admitted[stage] = self.admitted.get(stage, 0) + 1
         if self._active[stage] == 1:
             self._busy_since[stage] = time.monotonic()
-        return True
+
+    def admit_replica(self, stage: str, reserved: bool = False) \
+            -> int | None:
+        """Replicated-stage admission: returns the replica index the
+        frame admits into (round-robin over live slots with a free
+        per-replica credit), or None when the group is full.  The
+        reservation discipline mirrors ``try_admit`` -- a fresh attempt
+        may only take capacity beyond the credits promised to popped
+        waiter tokens."""
+        group = self.groups[stage]
+        if reserved:
+            self.cancel_reservation(stage)
+        elif group.free_slots() <= self._reserved.get(stage, 0):
+            return None
+        index = group.pick()
+        if index is None:
+            return None
+        group.admit(index)
+        self._count_admit(stage)
+        return index
 
     def cancel_reservation(self, stage: str) -> None:
         if self._reserved.get(stage, 0) > 0:
@@ -192,9 +411,14 @@ class StageScheduler:
             self.queued[stage] = self.queued.get(stage, 0) + 1
             waiters.append(token)
 
-    def release(self, stage: str):
-        """Return one credit; returns the next waiter token to resume
-        (or None)."""
+    def release(self, stage: str, replica: int | None = None,
+                ok: bool | None = True):
+        """Return one credit (the given replica's, for a replicated
+        stage -- ``ok`` carries the canary verdict for a half-open
+        slot); returns the next waiter token to resume (or None)."""
+        group = self.groups.get(stage)
+        if group is not None and replica is not None:
+            group.release(replica, ok=ok)
         if self._active.get(stage, 0) > 0:
             self._active[stage] -= 1
             if self._active[stage] == 0 \
@@ -204,14 +428,20 @@ class StageScheduler:
                 self._busy_since[stage] = None
         return self.next_waiter(stage)
 
+    def _has_capacity(self, stage: str) -> bool:
+        group = self.groups.get(stage)
+        if group is not None:
+            return group.free_slots() > self._reserved.get(stage, 0)
+        return self._active.get(stage, 0) \
+            + self._reserved.get(stage, 0) < self.depth
+
     def next_waiter(self, stage: str):
         """Pop the next waiter when an unreserved credit is available
         (used both on release and when a popped waiter turned out
         dead); the popped token takes a reservation on that credit
         until its admission post lands."""
         waiters = self._waiters.get(stage)
-        if waiters and self._active.get(stage, 0) \
-                + self._reserved.get(stage, 0) < self.depth:
+        if waiters and self._has_capacity(stage):
             self._reserved[stage] = self._reserved.get(stage, 0) + 1
             return waiters.popleft()
         return None
@@ -230,6 +460,8 @@ class StageScheduler:
             self._busy[stage] = 0.0
             if self._busy_since.get(stage) is not None:
                 self._busy_since[stage] = now
+        for group in self.groups.values():
+            group.reset_window()
         self._window_start = now
 
     def occupancy(self, stage: str) -> float:
@@ -243,21 +475,34 @@ class StageScheduler:
 
     # -- reporting ---------------------------------------------------------
 
+    def _executed(self, stage: str) -> int:
+        """Worker jobs completed for a stage, summed over its replica
+        workers when replicated."""
+        return sum(worker.executed
+                   for key, worker in self._executors.items()
+                   if key == stage
+                   or (isinstance(key, tuple) and key[0] == stage))
+
     @property
     def stats(self) -> dict:
-        return {stage: {"active": self._active.get(stage, 0),
-                        "admitted": self.admitted.get(stage, 0),
-                        "queued": self.queued.get(stage, 0),
-                        "waiting": self.waiting(stage),
-                        "reserved": self._reserved.get(stage, 0),
-                        "depth": self.depth,
-                        # Worker jobs the stage's executor completed --
-                        # with "admitted" this localizes a stall to
-                        # admission (credits) vs execution (worker).
-                        "executed": self._executors[stage].executed
-                        if stage in self._executors else 0,
-                        "occupancy": round(self.occupancy(stage), 4)}
-                for stage in self.stages}
+        result = {}
+        for stage in self.stages:
+            entry = {"active": self._active.get(stage, 0),
+                     "admitted": self.admitted.get(stage, 0),
+                     "queued": self.queued.get(stage, 0),
+                     "waiting": self.waiting(stage),
+                     "reserved": self._reserved.get(stage, 0),
+                     "depth": self.depth,
+                     # Worker jobs the stage's executor completed --
+                     # with "admitted" this localizes a stall to
+                     # admission (credits) vs execution (worker).
+                     "executed": self._executed(stage),
+                     "occupancy": round(self.occupancy(stage), 4)}
+            group = self.groups.get(stage)
+            if group is not None:
+                entry["replicas"] = group.stats
+            result[stage] = entry
+        return result
 
     def stop(self):
         for worker in self._executors.values():

@@ -67,6 +67,12 @@ class Frame:
     # between admissions means the frame re-enters on fresh submeshes).
     stage: str | None = None
     stage_generation: int = 0
+    # Replicated stages (ISSUE 7): which replica submesh of ``stage``
+    # this frame's admission landed on (None for unreplicated stages).
+    # The hop transfer, the worker pick and the element's ``self.plan``
+    # all key off it; a replica failover replays exactly the frames
+    # whose (stage, stage_replica) matches the dead slot.
+    stage_replica: int | None = None
     # The stage this frame is QUEUED for (admission denied, waiting for
     # a credit).  Popped waiter tokens are validated against it: a
     # stale token from a destroyed stream must never admit a recreated

@@ -96,24 +96,30 @@ class JitCache:
         self.hits = 0
         self.misses = 0
 
-    def _key(self, fn, args, kwargs):
+    def _key(self, fn, args, kwargs, context=None):
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
         sig = tuple(
             (leaf.shape, str(leaf.dtype)) if hasattr(leaf, "shape")
             else repr(leaf) for leaf in leaves)
-        return (id(fn), treedef, sig)
+        return (id(fn), treedef, sig, context)
 
-    def probe(self, fn, args: tuple, kwargs: dict | None = None) -> bool:
+    def probe(self, fn, args: tuple, kwargs: dict | None = None,
+              context=None) -> bool:
         """True when a call with these arguments would MISS (trace +
         compile) -- lets callers time/annotate first-use compiles
-        without racing the counters."""
-        return self._key(fn, args, kwargs or {}) not in self._compiled
+        without racing the counters.  ``context`` partitions the key
+        space: a replicated stage's submeshes share avals but not
+        executables (jax re-specializes per sharding), so dispatchers
+        pass the replica index to keep hit/miss/probe accounting
+        honest per replica."""
+        return self._key(fn, args, kwargs or {}, context) \
+            not in self._compiled
 
     def __call__(self, fn: Callable) -> Callable:
         jitted = jax.jit(fn, **self._jit_kwargs)
 
-        def wrapper(*args, **kwargs):
-            key = self._key(fn, args, kwargs)
+        def wrapper(*args, _cache_context=None, **kwargs):
+            key = self._key(fn, args, kwargs, _cache_context)
             if key in self._compiled:
                 self.hits += 1
             else:
@@ -176,6 +182,20 @@ class StagePlacement:
     until profiles exist).  ``replace()`` re-resolves auto splits
     against the survivors, so the balance tracks both the profile and
     the shrinking pool.
+
+    Replicated stages (ISSUE 7): ``assign(..., replicas={stage: N})``
+    splits a stage's allocation into N data-parallel **replica
+    submeshes** -- contiguous slices of the topology-sorted chunk, each
+    its own MeshPlan, so ICI locality holds within a replica.  Fixed
+    requests describe ONE replica (total = prod(axes) * N, so
+    power-of-two per-replica shapes stay power-of-two); ``auto``
+    requests split the stage's cost-proportional share near-equally
+    across the replicas.  ``drop_replica`` retires ONE replica's chips
+    without touching any peer's submesh (the peer-shedding failover
+    path: generation does NOT bump); ``reassign()`` re-fits the
+    original requests to the surviving pool (shedding replicas down to
+    ``replica_min`` before halving fixed axes) -- the background
+    rebuild after a failover, and the autoscaler's re-split.
     """
 
     def __init__(self, devices: Sequence | None = None):
@@ -185,9 +205,19 @@ class StagePlacement:
         self._requests: dict = {}
         self.generation = 0             # bumped by every replace()
         self.costs: dict[str, float] = {}    # stage -> EMA seconds/frame
-        self._shardings: dict = {}      # (stage, generation, spec) memo
+        self._shardings: dict = {}      # (stage, replica, gen, spec) memo
         self.transfer_puts = 0          # leaves actually moved
         self.transfer_skipped = 0       # leaves already resident
+        # Replicated stages: stage -> [MeshPlan | None per slot] (None =
+        # dead, retired by drop_replica), the DESIRED counts (what
+        # reassign restores toward), and the floor replica counts the
+        # fit loop respects when shedding.  ``replica_epoch`` bumps on
+        # every drop/reassign so per-replica plan caches (TPUElement)
+        # invalidate without a full-generation bump.
+        self.replica_plans: dict[str, list] = {}
+        self._replica_desired: dict[str, int] = {}
+        self._replica_min: dict[str, int] = {}
+        self.replica_epoch = 0
 
     # -- carving -----------------------------------------------------------
 
@@ -206,16 +236,27 @@ class StagePlacement:
                     else dict(want)
         return requests
 
-    def _resolve(self, requests: dict, pool: int) -> dict[str, dict]:
-        """Resolve ``auto`` requests into concrete mesh requests against
-        a pool of ``pool`` devices (each auto stage gets >= 1 chip; the
-        free chips split proportionally to recorded per-stage cost)."""
-        fixed_total = sum(int(np.prod(list(axes.values())))
-                          for axes in requests.values() if axes != "auto")
+    def _resolve(self, requests: dict, pool: int,
+                 replicas: dict | None = None) -> dict[str, int]:
+        """Resolve every stage to a TOTAL device count against a pool of
+        ``pool`` devices.  Fixed requests describe one replica, so a
+        replicated fixed stage takes prod(axes) * N; ``auto`` stages
+        split the free chips proportionally to recorded per-stage cost,
+        floored at one chip per replica."""
+        replicas = replicas or {}
+
+        def floor_of(name):
+            return max(1, replicas.get(name, 1))
+
+        fixed = {name: int(np.prod(list(axes.values())))
+                 * replicas.get(name, 1)
+                 for name, axes in requests.items() if axes != "auto"}
         auto = [name for name, axes in requests.items() if axes == "auto"]
-        if fixed_total + len(auto) > pool:
+        fixed_total = sum(fixed.values())
+        auto_floor = sum(floor_of(name) for name in auto)
+        if fixed_total + auto_floor > pool:
             raise ValueError(
-                f"stages want {fixed_total + len(auto)} devices, "
+                f"stages want {fixed_total + auto_floor} devices, "
                 f"have {pool}")
         shares: dict[str, int] = {}
         if auto:
@@ -231,40 +272,77 @@ class StagePlacement:
                 weights = {name: (w if w > 0 else floor)
                            for name, w in weights.items()}
             total_w = sum(weights.values())
-            shares = {name: max(1, int(free * weights[name] / total_w))
+            shares = {name: max(floor_of(name),
+                                int(free * weights[name] / total_w))
                       for name in auto}
             # Largest-remainder fit to exactly ``free`` chips.
             while sum(shares.values()) > free:
-                name = max((n for n in auto if shares[n] > 1),
+                name = max((n for n in auto
+                            if shares[n] > floor_of(n)),
                            key=lambda n: shares[n])
                 shares[name] -= 1
             while sum(shares.values()) < free:
                 name = max(auto, key=lambda n: (
                     free * weights[n] / total_w - shares[n]))
                 shares[name] += 1
-        return {name: ({"dp": shares[name]} if axes == "auto"
-                       else dict(axes))
+        return {name: (shares[name] if axes == "auto" else fixed[name])
                 for name, axes in requests.items()}
 
-    def assign(self, stages: dict, costs: dict | None = None) \
-            -> dict[str, MeshPlan]:
+    def assign(self, stages: dict, costs: dict | None = None,
+               replicas: dict | None = None,
+               replica_min: dict | None = None) -> dict[str, MeshPlan]:
         """stages: name -> chip count, {axis: size} mesh request, or
         ``"auto"``.  ``costs`` (stage -> seconds) seeds the profile the
-        auto split balances on."""
+        auto split balances on.  ``replicas`` (stage -> N >= 1) splits
+        those stages' allocations into N replica submeshes (a fixed
+        request then describes ONE replica); ``replica_min`` floors the
+        counts the fit loop may shed to under device loss."""
         if costs:
             for name, seconds in costs.items():
                 self.record_cost(name, float(seconds))
         requests = self._normalize(stages)
-        resolved = self._resolve(requests, len(self.devices))
+        replicas = {name: max(1, int(count))
+                    for name, count in (replicas or {}).items()
+                    if name in requests}
         self._requests = requests
-        self.plans = {}
-        cursor = 0
-        for name, axes in resolved.items():
-            count = int(np.prod(list(axes.values())))
-            chunk = self.devices[cursor:cursor + count]
-            cursor += count
-            self.plans[name] = MeshPlan(make_mesh(axes, chunk))
+        self._replica_desired = dict(replicas)
+        if replica_min is not None:
+            self._replica_min = {name: max(1, int(count))
+                                 for name, count in replica_min.items()}
+        self._carve(requests, replicas)
         return self.plans
+
+    def _carve(self, requests: dict, replicas: dict) -> None:
+        """Cut the topology-sorted pool into per-stage chunks (and
+        per-replica sub-chunks) for already-fitted requests."""
+        resolved = self._resolve(requests, len(self.devices), replicas)
+        self.plans = {}
+        self.replica_plans = {}
+        cursor = 0
+        for name, axes in requests.items():
+            total = resolved[name]
+            chunk = self.devices[cursor:cursor + total]
+            cursor += total
+            if name in replicas:
+                count = replicas[name]
+                subs, pos = [], 0
+                base, rem = divmod(total, count)
+                for index in range(count):
+                    size = base + (1 if index < rem else 0)
+                    sub = chunk[pos:pos + size]
+                    pos += size
+                    sub_axes = dict(axes) if axes != "auto" \
+                        else {"dp": size}
+                    subs.append(MeshPlan(make_mesh(sub_axes, sub)))
+                self.replica_plans[name] = subs
+                # The whole-stage plan (stage_devices, default hops,
+                # stats) spans every replica's chips as one dp pool.
+                self.plans[name] = MeshPlan(
+                    make_mesh({"dp": total}, chunk))
+            else:
+                plan_axes = dict(axes) if axes != "auto" \
+                    else {"dp": total}
+                self.plans[name] = MeshPlan(make_mesh(plan_axes, chunk))
 
     def record_cost(self, stage: str, seconds: float) -> None:
         """EMA of the measured per-frame cost of a stage (fed from the
@@ -274,13 +352,63 @@ class StagePlacement:
         self.costs[stage] = float(seconds) if prior is None \
             else 0.75 * prior + 0.25 * float(seconds)
 
+    def _fit(self, pool_size: int) -> tuple[dict, dict]:
+        """Shrink the ORIGINAL requests (and desired replica counts)
+        until they fit ``pool_size`` devices: replicated stages shed
+        replicas first (graceful N-1 degradation, floored at
+        ``replica_min``), then fixed stages halve their largest axis
+        (power-of-two steps keep dp/tp/fsdp shardings valid)."""
+        requests = {name: (axes if axes == "auto" else dict(axes))
+                    for name, axes in self._requests.items()}
+        replicas = dict(self._replica_desired)
+
+        def need():
+            total = 0
+            for name, axes in requests.items():
+                count = replicas.get(name, 1)
+                if axes == "auto":
+                    total += max(1, count)
+                else:
+                    total += int(np.prod(list(axes.values()))) * count
+            return total
+
+        def stage_need(name):
+            axes = requests[name]
+            count = replicas.get(name, 1)
+            return count if axes == "auto" \
+                else int(np.prod(list(axes.values()))) * count
+
+        while need() > pool_size:
+            sheddable = [name for name, count in replicas.items()
+                         if count > self._replica_min.get(name, 1)]
+            if sheddable:
+                name = max(sheddable, key=stage_need)
+                replicas[name] -= 1
+                continue
+            shrinkable = [name for name, axes in requests.items()
+                          if axes != "auto"
+                          and int(np.prod(list(axes.values()))) > 1]
+            if not shrinkable:
+                raise RuntimeError(
+                    f"cannot shrink stages below one device "
+                    f"({pool_size} survivors for "
+                    f"{len(requests)} stages)")
+            name = max(shrinkable,
+                       key=lambda n: int(np.prod(
+                           list(requests[n].values()))))
+            axes = requests[name]
+            axis = max(axes, key=axes.get)
+            axes[axis] = max(1, axes[axis] // 2)
+        return requests, replicas
+
     def replace(self, failed_devices: Sequence) -> dict[str, MeshPlan]:
         """Re-place every stage onto the surviving devices (SURVEY.md
         §5.3 TPU-equiv: re-shard onto surviving chips).
 
         Failed devices leave the pool permanently (survivors keep their
-        topology-sorted order, so chunks stay ICI-contiguous); fixed
-        stage requests shrink by halving their largest axis
+        topology-sorted order, so chunks stay ICI-contiguous);
+        replicated stages shed replicas first (down to ``replica_min``),
+        then fixed stage requests shrink by halving their largest axis
         (power-of-two steps keep dp/tp/fsdp shardings valid) until the
         total fits, and ``auto`` stages re-split the remaining pool by
         recorded cost.  Plans are rebuilt in place -- elements must drop
@@ -292,39 +420,102 @@ class StagePlacement:
             return self.plans
         if not survivors:
             raise RuntimeError("no surviving devices to re-place onto")
-        requests = {name: (axes if axes == "auto" else dict(axes))
-                    for name, axes in self._requests.items()}
-        n_auto = sum(1 for axes in requests.values() if axes == "auto")
-
-        def fixed_total(reqs):
-            return sum(int(np.prod(list(axes.values())))
-                       for axes in reqs.values() if axes != "auto")
-
-        while fixed_total(requests) + n_auto > len(survivors):
-            # Shrink the fixed stage holding the most chips, on its
-            # largest axis; every request bottoms out at one chip.
-            shrinkable = [name for name, axes in requests.items()
-                          if axes != "auto"
-                          and int(np.prod(list(axes.values()))) > 1]
-            if not shrinkable:
-                raise RuntimeError(
-                    f"cannot shrink stages below one device "
-                    f"({len(survivors)} survivors for "
-                    f"{len(requests)} stages)")
-            name = max(shrinkable,
-                       key=lambda n: int(np.prod(
-                           list(requests[n].values()))))
-            axes = requests[name]
-            axis = max(axes, key=axes.get)
-            axes[axis] = max(1, axes[axis] // 2)
+        requests, replicas = self._fit(len(survivors))
         self.devices = survivors
         self._shardings.clear()
         self.generation += 1
-        self.assign(requests)
+        self.replica_epoch += 1
+        self._carve(requests, replicas)
+        return self.plans
+
+    def reassign(self) -> dict[str, MeshPlan]:
+        """Re-fit the ORIGINAL requests (desired replica counts
+        included) onto the current pool and re-carve every stage: the
+        background rebuild of a dropped replica, and the autoscaler's
+        re-split after ``set_replicas``.  Bumps the generation --
+        callers must invalidate plans/frames exactly as after
+        ``replace()``."""
+        requests, replicas = self._fit(len(self.devices))
+        self._shardings.clear()
+        self.generation += 1
+        self.replica_epoch += 1
+        self._carve(requests, replicas)
         return self.plans
 
     def plan(self, stage: str) -> MeshPlan:
         return self.plans[stage]
+
+    # -- replicated stages -------------------------------------------------
+
+    @property
+    def has_replicas(self) -> bool:
+        return bool(self.replica_plans)
+
+    def replica_total(self, stage: str) -> int:
+        """Slots (live or dead) of a replicated stage; 0 when the stage
+        is not replicated."""
+        return len(self.replica_plans.get(stage, ()))
+
+    def live_replicas(self, stage: str) -> list[int]:
+        return [index for index, plan
+                in enumerate(self.replica_plans.get(stage, ()))
+                if plan is not None]
+
+    def replica_plan(self, stage: str, index: int) -> MeshPlan:
+        plan = self.replica_plans[stage][index]
+        if plan is None:
+            raise KeyError(f"stage {stage!r} replica {index} is dead")
+        return plan
+
+    def replica_devices(self, stage: str, index: int) -> set:
+        plans = self.replica_plans.get(stage, ())
+        if index >= len(plans) or plans[index] is None:
+            return set()
+        return set(plans[index].mesh.devices.flat)
+
+    def replica_of(self, stage: str, device) -> int | None:
+        """Which live replica of ``stage`` owns ``device`` (None when
+        the stage is not replicated or the device is not placed
+        there)."""
+        for index, plan in enumerate(self.replica_plans.get(stage, ())):
+            if plan is not None and device in set(plan.mesh.devices.flat):
+                return index
+        return None
+
+    def set_replicas(self, stage: str, count: int) -> None:
+        """Update a replicated stage's DESIRED count (the autoscaler's
+        knob); takes effect at the next ``reassign()``."""
+        if stage not in self._replica_desired:
+            raise KeyError(f"stage {stage!r} is not replicated")
+        self._replica_desired[stage] = max(
+            self._replica_min.get(stage, 1), int(count))
+
+    def drop_replica(self, stage: str, index: int) -> set:
+        """Retire ONE replica's chips (peer-shedding failover): the
+        devices leave the pool permanently, the slot reads dead, and --
+        the point -- no other submesh is touched: peers keep serving on
+        their exact meshes, so ``generation`` does NOT bump (only
+        ``replica_epoch``, which invalidates per-replica plan caches
+        and this stage's memoized shardings).  Returns the retired
+        device set (empty when the slot is unknown/already dead)."""
+        subs = self.replica_plans.get(stage)
+        if not subs or index >= len(subs) or subs[index] is None:
+            return set()
+        dead = set(subs[index].mesh.devices.flat)
+        subs[index] = None
+        self.devices = [d for d in self.devices if d not in dead]
+        alive = [d for plan in subs if plan is not None
+                 for d in plan.mesh.devices.flat]
+        if alive:
+            self.plans[stage] = MeshPlan(
+                make_mesh({"dp": len(alive)}, alive))
+        else:
+            self.plans.pop(stage, None)
+        self.replica_epoch += 1
+        self._shardings = {key: value
+                           for key, value in self._shardings.items()
+                           if key[0] != stage}
+        return dead
 
     def stage_devices(self, stage: str) -> set:
         """The devices a stage's submesh currently occupies (empty for
@@ -337,27 +528,32 @@ class StagePlacement:
 
     # -- stage hops --------------------------------------------------------
 
-    def stage_sharding(self, stage: str, spec: tuple = ()) -> NamedSharding:
+    def stage_sharding(self, stage: str, spec: tuple = (),
+                       replica: int | None = None) -> NamedSharding:
         """The memoized NamedSharding frames reshard onto when hopping
-        to ``stage`` -- built once per (stage, generation, spec), not
-        per frame."""
-        key = (stage, self.generation, tuple(spec) if spec else None)
+        to ``stage`` (or one replica's submesh of it) -- built once per
+        (stage, replica, generation, spec), not per frame."""
+        key = (stage, replica, self.generation,
+               tuple(spec) if spec else None)
         sharding = self._shardings.get(key)
         if sharding is None:
-            plan = self.plans[stage]
+            plan = self.plans[stage] if replica is None \
+                else self.replica_plan(stage, replica)
             sharding = plan.shard(*spec) if spec else plan.replicated()
             self._shardings[key] = sharding
         return sharding
 
-    def transfer(self, value, to_stage: str, *spec):
-        """Reshard ``value`` (array or pytree) onto a stage's mesh.
+    def transfer(self, value, to_stage: str, *spec,
+                 replica: int | None = None):
+        """Reshard ``value`` (array or pytree) onto a stage's mesh (a
+        single replica's submesh when ``replica`` is given).
 
         Non-blocking: ``jax.device_put`` dispatches the ICI copy and
         returns immediately, so the hop overlaps the upstream stage's
         next-frame compute.  Leaves whose committed sharding already IS
         the target sharding pass through untouched (kills the per-frame
         no-op device_put walk for values resident on the stage)."""
-        sharding = self.stage_sharding(to_stage, spec)
+        sharding = self.stage_sharding(to_stage, spec, replica=replica)
 
         def hop(leaf):
             if not hasattr(leaf, "shape"):
@@ -372,14 +568,22 @@ class StagePlacement:
 
     @property
     def stats(self) -> dict:
-        return {"generation": self.generation,
-                "stages": {name: int(plan.mesh.devices.size)
-                           for name, plan in self.plans.items()},
-                "costs_ms": {name: round(cost * 1000.0, 3)
-                             for name, cost in self.costs.items()},
-                "transfer_puts": self.transfer_puts,
-                "transfer_skipped": self.transfer_skipped,
-                "shardings_cached": len(self._shardings)}
+        result = {"generation": self.generation,
+                  "stages": {name: int(plan.mesh.devices.size)
+                             for name, plan in self.plans.items()},
+                  "costs_ms": {name: round(cost * 1000.0, 3)
+                               for name, cost in self.costs.items()},
+                  "transfer_puts": self.transfer_puts,
+                  "transfer_skipped": self.transfer_skipped,
+                  "shardings_cached": len(self._shardings)}
+        if self.replica_plans:
+            result["replica_epoch"] = self.replica_epoch
+            result["replicas"] = {
+                name: [None if plan is None
+                       else int(plan.mesh.devices.size)
+                       for plan in plans]
+                for name, plans in self.replica_plans.items()}
+        return result
 
 
 def tree_device_put(tree, plan: MeshPlan, spec: P | None = None):
@@ -407,6 +611,11 @@ def decode_array(data: bytes) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # TPU element base class.
 
+# Sentinel for TPUElement's not-yet-computed placement-stage cache
+# (None is a valid resolved value: "unplaced").
+_UNRESOLVED = object()
+
+
 class TPUElement(PipelineElement):
     """PipelineElement hosting jitted computation on a device mesh.
 
@@ -431,14 +640,60 @@ class TPUElement(PipelineElement):
     def __init__(self, context):
         super().__init__(context)
         self._plan: MeshPlan | None = None
+        self._replica_plan_cache: dict = {}
+        self._stage_name_cache = _UNRESOLVED
         self.jit_cache = JitCache()
         self.bucketer = ShapeBucketer()
 
     @property
     def plan(self) -> MeshPlan:
+        # Replicated stages (ISSUE 7): while a stage worker executes
+        # this element for a specific replica, ``self.plan`` IS that
+        # replica's submesh -- an element-side put/shard lands on the
+        # replica's chips, never on a peer's (or a dead slot's).  The
+        # cache keys on the placement's replica_epoch so a
+        # drop/reassign invalidates it without a full on_replacement.
+        pipeline = self.pipeline
+        placements = getattr(pipeline, "stage_placement", None)
+        current = getattr(pipeline, "current_replica", None)
+        context = current() if callable(current) else None
+        if context is not None and placements is not None:
+            stage, index = context
+            if stage in placements.replica_plans \
+                    and self._placement_stage() == stage:
+                key = (stage, index, placements.generation,
+                       placements.replica_epoch)
+                plan = self._replica_plan_cache.get(key)
+                if plan is None:
+                    plan = placements.replica_plan(stage, index)
+                    self._replica_plan_cache = {key: plan}
+                return plan
         if self._plan is None:
             self._plan = self._resolve_placement()
         return self._plan
+
+    def _placement_stage(self) -> str | None:
+        """The placed-stage name this element's placement resolves to
+        (None when unplaced) -- same lookup order as
+        ``_resolve_placement``.  Cached: ``self.plan`` consults it on
+        every access in the replica worker hot path, and the binding is
+        structural (definition placement block / parameter), not
+        per-frame.  Cleared by ``on_replacement``."""
+        if self._stage_name_cache is not _UNRESOLVED:
+            return self._stage_name_cache
+        placements = getattr(self.pipeline, "stage_placement", None)
+        if placements is None:
+            return None                 # no placement yet: don't cache
+        placement, _ = self.get_parameter("placement", "local")
+        name = None
+        for key in (placement, self.name):
+            if isinstance(key, str) and (
+                    key in placements.plans
+                    or key in placements.replica_plans):
+                name = key
+                break
+        self._stage_name_cache = name
+        return name
 
     def _resolve_placement(self) -> MeshPlan:
         placement, _ = self.get_parameter("placement", "local")
@@ -477,6 +732,8 @@ class TPUElement(PipelineElement):
         ``checkpoint`` parameter when set, so recovery restores real
         weights, not random init."""
         self._plan = None
+        self._replica_plan_cache = {}
+        self._stage_name_cache = _UNRESOLVED
         self.jit_cache = JitCache()
 
     def put(self, value, *spec):

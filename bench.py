@@ -1490,6 +1490,181 @@ def bench_pipeline_faults() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 4e. Replicated stages (ISSUE 7): dp-N fps scaling of a replicated
+#     stage (the designed path to the >= 0.8 e2e/device fps ratio --
+#     detect is the e2e bottleneck and ``replicas`` lets it scale out),
+#     and the robustness dividend measured head-to-head:
+#     ``replica_failover_ms`` (kill one of N under load, peers keep
+#     serving) vs ``replica_full_replace_ms`` (the stop-the-world
+#     rebuild the same load pays without replication).
+
+REPLICA_FRAMES = 24
+
+
+def bench_pipeline_replicas() -> dict:
+    import numpy as np
+    import jax
+
+    n = len(jax.devices())
+    if n < 4:
+        return {"pipeline_replicas_skipped":
+                f"needs >= 4 devices, have {n}"}
+    from aiko_services_tpu.pipeline import Pipeline
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.transport import reset_broker
+
+    result: dict = {}
+    rng = np.random.default_rng(0)
+    frames = [rng.standard_normal((32, 32)).astype(np.float32)
+              for _ in range(4)]
+
+    def fresh_runtime():
+        reset_broker()
+        reset_process()
+        runtime = init_process(transport="loopback")
+        runtime.initialize()
+        return runtime
+
+    def run_frames(runtime, pipeline, count, stream_id, on_row=None,
+                   timeout=300.0):
+        responses: "queue.Queue" = queue.Queue()
+        collected: list = []
+        for i in range(count):
+            pipeline.process_frame_local({"x": frames[i % len(frames)]},
+                                         stream_id=stream_id,
+                                         queue_response=responses)
+
+        def drain():
+            while not responses.empty():
+                collected.append(responses.get())
+                if on_row is not None:
+                    on_row()
+            return len(collected) >= count
+        runtime.run(until=drain, timeout=timeout)
+        return collected
+
+    # -- dp-N fps scaling: the same stage, one chip per replica, at
+    # replicas 1 / 2 / 4 -- per-replica workers run frames of one
+    # stream concurrently, so fps scales with the live replica count.
+    scaling: dict[int, float] = {}
+    for count in (1, 2, 4):
+        if count > n:
+            continue
+        runtime = fresh_runtime()
+        pipeline = Pipeline(
+            {"version": 0, "name": f"bench_dp{count}", "runtime": "jax",
+             "graph": ["(detect)"],
+             "parameters": {"transfer_guard": "disallow"},
+             "elements": [
+                 {**element("detect", "StageWork", ["x"], ["x"],
+                            {"busy_ms": STAGE_BUSY_MS, "factor": 2.0}),
+                  "placement": {"devices": 1, "replicas": count}}]},
+            runtime=runtime)
+        warm = run_frames(runtime, pipeline, 4, "warm")
+        if len(warm) < 4:
+            runtime.terminate()
+            return result | {"pipeline_replicas_error":
+                             f"dp{count} warmup stalled"}
+        start = time.perf_counter()
+        rows = run_frames(runtime, pipeline, REPLICA_FRAMES, "timed")
+        elapsed = time.perf_counter() - start
+        okay = all(row[4] for row in rows)
+        in_order = [row[1] for row in rows] == sorted(
+            row[1] for row in rows)
+        runtime.terminate()
+        if len(rows) < REPLICA_FRAMES or not okay or not in_order:
+            return result | {"pipeline_replicas_error":
+                             f"dp{count} pass incomplete"}
+        scaling[count] = len(rows) / elapsed
+        result[f"replica_fps_dp{count}"] = round(scaling[count], 2)
+    top = max(scaling)
+    if scaling.get(1):
+        result["replica_dp_scaling"] = round(
+            scaling[top] / scaling[1], 2)
+
+    # -- failover vs full replace, same shape, same load: detect at
+    # ``replicas: 3`` plus an unreplicated llm.  Pass 1 kills ONE
+    # detect replica (peer-shed: kill -> first completion after the
+    # shed).  Pass 2 kills an llm chip -- outside any replica, so the
+    # same pipeline pays for the stop-the-world replace() -- measured
+    # kill -> first completion identically.
+    per = max(1, n // 4)
+    runtime = fresh_runtime()
+    pipeline = Pipeline(
+        {"version": 0, "name": "bench_failover", "runtime": "jax",
+         "graph": ["(detect llm)"],
+         "parameters": {"transfer_guard": "disallow",
+                        "replay_limit": 4,
+                        "replica_rebuild_ms": 0},
+         "elements": [
+             {**element("detect", "StageWork", ["x"], ["x"],
+                        {"busy_ms": STAGE_BUSY_MS, "factor": 2.0}),
+              "placement": {"devices": per, "replicas": 3}},
+             {**element("llm", "StageWork", ["x"], ["x"],
+                        {"busy_ms": STAGE_BUSY_MS / 4, "factor": 3.0}),
+              "placement": {"devices": n - 3 * per}}]},
+        runtime=runtime)
+    warm = run_frames(runtime, pipeline, 4, "warm")
+    if len(warm) < 4:
+        runtime.terminate()
+        return result | {"pipeline_replicas_error": "failover warmup "
+                         "stalled"}
+    marks: dict = {}
+    pipeline.add_hook_handler(
+        "pipeline.replica_failover:0",
+        lambda component, hook, variables:
+            marks.setdefault("shed", time.perf_counter()))
+    pipeline.add_hook_handler(
+        "pipeline.replacement:0",
+        lambda component, hook, variables:
+            marks.setdefault("replaced", time.perf_counter()))
+
+    def note_recovery():
+        if "shed" in marks and "shed_recovered" not in marks:
+            marks["shed_recovered"] = time.perf_counter()
+        if "replaced" in marks and "replace_recovered" not in marks:
+            marks["replace_recovered"] = time.perf_counter()
+
+    pipeline.post_self("fail_replica", ["detect", 1], delay=0.05)
+    rows = run_frames(runtime, pipeline, REPLICA_FRAMES, "kill",
+                      on_row=note_recovery)
+    if len(rows) < REPLICA_FRAMES or not all(row[4] for row in rows):
+        runtime.terminate()
+        return result | {"pipeline_replicas_error":
+                         "failover pass incomplete"}
+    if "shed" in marks and "shed_recovered" in marks:
+        result["replica_failover_ms"] = round(
+            (marks["shed_recovered"] - marks["shed"]) * 1000.0, 1)
+    result["replica_failover_shed_ms"] = \
+        pipeline.share.get("replica_failover_ms")
+    result["replica_failover_replayed"] = \
+        pipeline.share.get("frames_replayed", 0)
+    result["replica_live_after_failover"] = \
+        len(pipeline.stage_placement.live_replicas("detect"))
+
+    dead = list(pipeline.stage_placement.plans["llm"]
+                .mesh.devices.flat)[:1]
+    pipeline.post_self("replace_failed_devices", [dead], delay=0.05)
+    rows = run_frames(runtime, pipeline, REPLICA_FRAMES, "replace",
+                      on_row=note_recovery)
+    okay = all(row[4] for row in rows)
+    runtime.terminate()
+    if len(rows) >= REPLICA_FRAMES and okay \
+            and "replaced" in marks and "replace_recovered" in marks:
+        result["replica_full_replace_ms"] = round(
+            (marks["replace_recovered"] - marks["replaced"]) * 1000.0, 1)
+
+    previous = _previous_bench()
+    for key in ("replica_fps_dp1", "replica_fps_dp2", "replica_fps_dp4",
+                "replica_dp_scaling", "replica_failover_ms",
+                "replica_full_replace_ms"):
+        prior = previous.get(key)
+        if prior and result.get(key):
+            result[f"{key}_vs_baseline"] = round(result[key] / prior, 2)
+    return result
+
+
+# ---------------------------------------------------------------------------
 # 5. ASR real-time factor (BASELINE config 5): seconds of audio
 #    transcribed per wall-clock second, batch of chunks, one dispatch
 #    (mel frontend + encoder + KV-cached 128-token greedy decode all
@@ -1755,6 +1930,7 @@ def main() -> int:
             ("bench_pipeline_fusion", bench_pipeline_fusion),
             ("bench_pipeline_stages", bench_pipeline_stages),
             ("bench_pipeline_faults", bench_pipeline_faults),
+            ("bench_pipeline_replicas", bench_pipeline_replicas),
             ("bench_asr", lambda: bench_asr(rtt)),
             ("bench_speech_e2e", bench_speech_e2e)):
         try:

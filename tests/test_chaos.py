@@ -829,3 +829,179 @@ def test_device_window_invalidate_drops_dead_leaves():
     assert window.invalidate({devices[0]}) == 1
     assert window.outstanding == 1
     window.drain()                          # survivor still paceable
+
+
+# -- replicated stages: replica death under load (ISSUE 7) -------------------
+
+
+def replicated_chaos_definition(parameters=None):
+    """detect at ``replicas: 3`` (2 chips each) feeding an unreplicated
+    placed llm -- the BENCH e2e shape, 8 chips total on the CPU mesh."""
+    return {
+        "version": 0, "name": "p_replica_chaos", "runtime": "jax",
+        "graph": ["(detect llm)"],
+        "parameters": dict(parameters or {}),
+        "elements": [
+            element("detect", "BusyStage",
+                    parameters={"busy_ms": 25.0},
+                    placement={"devices": 2, "replicas": 3}),
+            element("llm", "BusyStage", parameters={"busy_ms": 5.0},
+                    placement={"devices": 2})]}
+
+
+def test_replica_device_kill_sheds_to_peers_in_order_under_load(runtime):
+    """The ISSUE 7 acceptance walk: detect at ``replicas: 3``, a
+    ``device_kill`` rule targeting ONE replica (``detect#1``) fires
+    under >= 12 in-flight frames across two streams.  Every stream
+    completes -- zero dropped, zero duplicated, in ingest order per
+    stream -- the group keeps serving at N-1 (no generation bump, the
+    peer-shed path, NOT stop-the-world replace), and the dead slot
+    shows on the telemetry gauges."""
+    pipeline = Pipeline(
+        replicated_chaos_definition(parameters={
+            "replay_limit": 3,
+            "replica_rebuild_ms": 0,        # hold the N-1 state
+            "telemetry": "on",
+            "health_probe_timeout": 2.0,
+            "fault_plan": {"rules": [
+                {"point": "device_kill", "target": "detect#1",
+                 "count": 1}]}}),
+        runtime=runtime)
+    n_frames = 7
+    responses_a: queue.Queue = queue.Queue()
+    responses_b: queue.Queue = queue.Queue()
+    ingest(pipeline, responses_a, n_frames, stream_id="a")
+    ingest(pipeline, responses_b, n_frames, stream_id="b")
+
+    # Wait until replica 1 actually holds admitted frames, then run the
+    # health probe: the armed rule marks exactly that submesh dead.
+    def replica1_busy():
+        return any(frame.stage == "detect" and frame.stage_replica == 1
+                   for stream in pipeline.streams.values()
+                   for frame in stream.frames.values())
+
+    assert run_until(runtime, replica1_busy, timeout=30.0), \
+        "no frame ever admitted to replica 1"
+    in_flight = sum(len(stream.frames)
+                    for stream in pipeline.streams.values())
+    assert in_flight >= 12, f"only {in_flight} frames in flight"
+    pipeline.post_self("check_device_health")
+    rows_a = collect(runtime, responses_a, n_frames, timeout=120.0)
+    rows_b = collect(runtime, responses_b, n_frames, timeout=120.0)
+    for rows in (rows_a, rows_b):
+        assert len(rows) == n_frames, \
+            f"{len(rows)}/{n_frames}: dropped frames after replica kill"
+        assert all(row[4] for row in rows), \
+            [row[5] for row in rows if not row[4]]
+        order = [row[1] for row in rows]
+        assert order == sorted(order), f"out of order: {order}"
+        assert len(order) == len(set(order)), "duplicate delivery"
+    # Peer-shed semantics: generation unchanged, peers alive at N-1,
+    # the dead replica's in-flight frames replayed.
+    placement = pipeline.stage_placement
+    assert placement.generation == 0, "failover escalated to replace()"
+    assert placement.live_replicas("detect") == [0, 2]
+    assert pipeline.share["replica_failovers"] == 1
+    assert pipeline.share["replica_failover_ms"] > 0
+    assert pipeline.share["frames_replayed"] > 0
+    assert pipeline.fault_stats()["plan"]["fired"] == {"device_kill": 1}
+    # Scrape-side view: the dead slot reads 0 on the replica_state
+    # gauge while its peers read 1.
+    states = {}
+    for line in pipeline.metrics_text().splitlines():
+        if line.startswith("aiko_replica_state{"):
+            states[line] = line.rsplit(" ", 1)[1]
+    assert sorted(states.values()) == ["0", "1", "1"], states
+    stats = pipeline.replica_stats()
+    assert stats["stages"]["detect"]["states"] == \
+        ["live", "dead", "live"]
+    pipeline.stop()
+
+
+def test_replica_failover_strictly_cheaper_than_full_replace(runtime):
+    """The robustness dividend, measured: peer-shedding one dead
+    replica (``replica_failover_ms``) is strictly cheaper than the
+    stop-the-world ``replace_failed_devices`` rebuild under comparable
+    in-flight load -- failover touches ONE submesh, replace re-carves
+    every stage and replays everything."""
+    pipeline = Pipeline(
+        replicated_chaos_definition(parameters={
+            "replay_limit": 4, "replica_rebuild_ms": 0}),
+        runtime=runtime)
+    placement = pipeline.stage_placement
+    n_frames = 8
+    responses: queue.Queue = queue.Queue()
+    ingest(pipeline, responses, n_frames, stream_id="a")
+
+    def detect_busy():
+        return sum(1 for stream in pipeline.streams.values()
+                   for frame in stream.frames.values()
+                   if frame.stage == "detect") >= 2
+
+    assert run_until(runtime, detect_busy, timeout=30.0)
+    pipeline.fail_replica("detect", 1)
+    failover_ms = pipeline.share["replica_failover_ms"]
+    rows = collect(runtime, responses, n_frames, timeout=120.0)
+    assert all(row[4] for row in rows), \
+        [row[5] for row in rows if not row[4]]
+
+    # Same pipeline, comparable load: now kill the llm stage's chips --
+    # outside any replica, so recovery MUST stop the world.
+    responses = queue.Queue()
+    ingest(pipeline, responses, n_frames, stream_id="b")
+
+    def llm_busy():
+        return sum(1 for stream in pipeline.streams.values()
+                   for frame in stream.frames.values()) >= 2
+
+    assert run_until(runtime, llm_busy, timeout=30.0)
+    dead = list(placement.plans["llm"].mesh.devices.flat)[:1]
+    start = time.perf_counter()
+    pipeline.replace_failed_devices(dead)
+    replace_ms = (time.perf_counter() - start) * 1000.0
+    rows = collect(runtime, responses, n_frames, timeout=120.0)
+    assert all(row[4] for row in rows), \
+        [row[5] for row in rows if not row[4]]
+    assert placement.generation == 1
+    assert failover_ms < replace_ms, (
+        f"peer-shed failover ({failover_ms:.2f} ms) not cheaper than "
+        f"full replace ({replace_ms:.2f} ms)")
+    pipeline.stop()
+
+
+def test_replica_scoped_dispatch_probe_spares_healthy_peers(runtime):
+    """Dispatch-time chip death on a replicated stage: the raising
+    frame's probe is SCOPED to its own replica's submesh, so the armed
+    ``device_kill`` confirms THAT replica dead and the peers never get
+    probed, marked, or replayed -- one slot fails, N-1 serve on,
+    generation unchanged."""
+    pipeline = Pipeline(
+        replicated_chaos_definition(parameters={
+            "replay_limit": 3,
+            "replica_rebuild_ms": 0,
+            "health_probe_timeout": 2.0,
+            "fault_plan": {"rules": [
+                # The FIRST detect dispatch raises; round-robin admits
+                # frame 0 to replica 0, so the scoped probe walks
+                # replica 0's chips and finds them dead.
+                {"point": "element_raise", "target": "detect",
+                 "count": 1},
+                {"point": "device_kill", "target": "detect#0",
+                 "count": 1}]}}),
+        runtime=runtime)
+    n_frames = 4
+    responses: queue.Queue = queue.Queue()
+    ingest(pipeline, responses, n_frames)
+    rows = collect(runtime, responses, n_frames, timeout=120.0)
+    assert len(rows) == n_frames
+    assert all(row[4] for row in rows), \
+        [row[5] for row in rows if not row[4]]
+    placement = pipeline.stage_placement
+    assert placement.generation == 0, \
+        "scoped probe escalated to a full replace"
+    assert placement.live_replicas("detect") == [1, 2]
+    assert pipeline.share["replica_failovers"] == 1
+    assert pipeline.share["frames_replayed"] >= 1
+    fired = pipeline.fault_stats()["plan"]["fired"]
+    assert fired == {"element_raise": 1, "device_kill": 1}
+    pipeline.stop()

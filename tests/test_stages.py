@@ -387,8 +387,9 @@ def test_replace_under_stage_parallel_execution(runtime):
         leaf = swag["x"]
         assert set(leaf.sharding.device_set) <= survivors, \
             "frame ran against a stale (pre-replacement) mesh"
-    # The new generation's hops filled a fresh sharding cache.
-    assert all(key[1] == 1 for key in placement._shardings)
+    # The new generation's hops filled a fresh sharding cache
+    # (key = (stage, replica, generation, spec)).
+    assert all(key[2] == 1 for key in placement._shardings)
     pipeline.stop()
 
 
@@ -658,3 +659,436 @@ def test_scheduler_occupancy_window():
     time.sleep(0.01)
     assert scheduler.occupancy("a") < 0.5           # idle since reset
     scheduler.stop()
+
+
+# -- replicated stages (ISSUE 7) ---------------------------------------------
+
+
+def replicated_definition(replicas=3, busy_ms=15.0, parameters=None,
+                          devices=2):
+    return {
+        "version": 0, "name": "p_replicas", "runtime": "jax",
+        "graph": ["(detect)"],
+        "parameters": dict(parameters or {}),
+        "elements": [
+            element("detect", "StageWork", ["x"], ["x"],
+                    {"busy_ms": busy_ms, "factor": 2.0},
+                    {"devices": devices, "replicas": replicas}),
+        ]}
+
+
+def pump(pipeline, count, stream_id="r", shape=(8, 8)):
+    responses = queue.Queue()
+    rng = np.random.default_rng(0)
+    for _ in range(count):
+        pipeline.process_frame_local(
+            {"x": rng.standard_normal(shape).astype(np.float32)},
+            stream_id=stream_id, queue_response=responses)
+    return responses
+
+
+def drain(rt, responses, count, timeout=120.0):
+    rows = []
+
+    def drained():
+        while not responses.empty():
+            rows.append(responses.get())
+        return len(rows) >= count
+
+    run_until(rt, drained, timeout=timeout)
+    return rows
+
+
+def test_replica_group_round_robin_and_depth():
+    from aiko_services_tpu.pipeline.stages import ReplicaGroup
+
+    group = ReplicaGroup("detect", 3, depth=1)
+    picks = []
+    for _ in range(3):
+        index = group.pick()
+        picks.append(index)
+        group.admit(index)
+    assert picks == [0, 1, 2]
+    assert group.pick() is None                 # window full everywhere
+    group.release(1)
+    assert group.pick() == 1                    # freed credit wins
+    assert group.stats["live"] == 3
+
+
+def test_replica_group_canary_lifecycle():
+    from aiko_services_tpu.pipeline.stages import (
+        REPLICA_DEAD, REPLICA_HALF_OPEN, REPLICA_LIVE, ReplicaGroup)
+
+    group = ReplicaGroup("detect", 2, depth=2)
+    group.fail(1)
+    assert group.states == [REPLICA_LIVE, REPLICA_DEAD]
+    assert group.failovers == 1
+    group.rebuild(2, half_open=[1])
+    assert group.states == [REPLICA_LIVE, REPLICA_HALF_OPEN]
+    # The half-open slot admits exactly ONE canary.
+    picks = [group.pick() for _ in range(3)]
+    for index in picks:
+        if index is not None:
+            group.admit(index)
+    assert picks.count(1) == 1
+    # Canary success closes the slot live.
+    group.release(1, ok=True)
+    assert group.states[1] == REPLICA_LIVE
+    # A second failure + rebuild, canary FAILURE re-kills.
+    group.fail(1)
+    group.rebuild(2, half_open=[1])
+    index = None
+    while index != 1:
+        index = group.pick()
+        group.admit(index)
+    group.release(1, ok=False)
+    assert group.states[1] == REPLICA_DEAD
+
+
+def test_replica_group_all_dead():
+    from aiko_services_tpu.pipeline.stages import ReplicaGroup
+
+    group = ReplicaGroup("detect", 2)
+    group.fail(0)
+    assert not group.all_dead()
+    group.fail(1)
+    assert group.all_dead()
+    assert group.pick() is None
+
+
+def test_scheduler_admit_replica_respects_reservations():
+    scheduler = StageScheduler(["detect"], depth=1,
+                               replicas={"detect": 2})
+    assert scheduler.admit_replica("detect") == 0
+    assert scheduler.admit_replica("detect") == 1
+    assert scheduler.admit_replica("detect") is None
+    scheduler.enqueue("detect", ["s", 0, "detect", True, None])
+    waiter = scheduler.release("detect", replica=0)
+    assert waiter is not None                   # popped with reservation
+    # A fresh admission may not steal the reserved credit...
+    assert scheduler.admit_replica("detect") is None
+    # ...but the reserved waiter itself admits.
+    assert scheduler.admit_replica("detect", reserved=True) is not None
+    scheduler.stop()
+
+
+def test_replicated_stage_round_robins_frames(runtime):
+    pipeline = Pipeline(replicated_definition(replicas=3, busy_ms=10.0),
+                        runtime=runtime)
+    group = pipeline.stage_scheduler.groups["detect"]
+    rows = drain(runtime, pump(pipeline, 12), 12)
+    assert len(rows) == 12
+    assert all(row[4] for row in rows), \
+        [row[5] for row in rows if not row[4]]
+    order = [row[1] for row in rows]
+    assert order == sorted(order)
+    # Admission spread across every replica, and the per-frame metric
+    # recorded which submesh each frame ran on.
+    assert all(count >= 2 for count in group.admitted), group.admitted
+    used = {row[3].get("stage_detect_replica") for row in rows}
+    assert used == {0, 1, 2}
+    stats = pipeline.replica_stats()
+    assert stats["stages"]["detect"]["live"] == 3
+    pipeline.stop()
+
+
+def test_single_replicated_stage_activates_scheduler(runtime):
+    """One placed stage normally runs the serial path, but replication
+    IS frame-level parallelism -- the scheduler must activate."""
+    pipeline = Pipeline(replicated_definition(replicas=2),
+                        runtime=runtime)
+    assert pipeline.stage_scheduler is not None
+    assert "detect" in pipeline.stage_scheduler.groups
+    pipeline.stop()
+
+
+def test_replica_failover_sheds_to_peers_in_order(runtime):
+    """Kill one replica of 3 mid-flight: its frames replay on the
+    peers, every frame completes IN ORDER, no duplicates, and the
+    stage keeps serving at N-1 -- the peer-shed path, generation
+    unchanged."""
+    pipeline = Pipeline(
+        replicated_definition(replicas=3, busy_ms=20.0,
+                              parameters={"replica_rebuild_ms": 0}),
+        runtime=runtime)
+    placement = pipeline.stage_placement
+    responses = pump(pipeline, 12)
+    pipeline.post_self("fail_replica", ["detect", 1], delay=0.05)
+    rows = drain(runtime, responses, 12)
+    assert len(rows) == 12, "stream hung after replica failover"
+    assert all(row[4] for row in rows), \
+        [row[5] for row in rows if not row[4]]
+    order = [row[1] for row in rows]
+    assert order == sorted(order), f"out of order: {order}"
+    assert len(order) == len(set(order)), "duplicate delivery"
+    # Peer-shed, not stop-the-world: no generation bump, peers alive.
+    assert placement.generation == 0
+    assert placement.live_replicas("detect") == [0, 2]
+    stats = pipeline.replica_stats()
+    assert stats["failovers"] == 1
+    assert stats["failover_ms"] > 0
+    assert pipeline.share["replica_failovers"] == 1
+    pipeline.stop()
+
+
+def test_replica_rebuild_readmits_half_open_behind_canary(runtime):
+    """After a failover the background rebuild restores the slot
+    HALF-OPEN: exactly one canary frame re-admits it, success closes
+    it live and it serves again."""
+    pipeline = Pipeline(
+        replicated_definition(replicas=3, busy_ms=10.0,
+                              parameters={"replica_rebuild_ms": 40}),
+        runtime=runtime)
+    group = pipeline.stage_scheduler.groups["detect"]
+    responses = pump(pipeline, 8)
+    pipeline.post_self("fail_replica", ["detect", 2], delay=0.03)
+    rows = drain(runtime, responses, 8)
+    assert all(row[4] for row in rows)
+    run_until(runtime,
+              lambda: pipeline.replica_stats()["rebuilds"] >= 1,
+              timeout=30.0)
+    walk = [(slot, state) for slot, state, _ in group.transitions]
+    assert (2, "dead") in walk
+    assert (2, "half_open") in walk
+    # More traffic: the canary closes the slot live and it serves.
+    rows2 = drain(runtime, pump(pipeline, 9, stream_id="r2"), 9)
+    assert all(row[4] for row in rows2)
+    assert group.states == ["live", "live", "live"]
+    used = {row[3].get("stage_detect_replica") for row in rows2}
+    assert 2 in used, "rebuilt replica never served"
+    pipeline.stop()
+
+
+def test_replica_canary_off_readmits_fully(runtime):
+    pipeline = Pipeline(
+        replicated_definition(
+            replicas=2, busy_ms=5.0,
+            parameters={"replica_rebuild_ms": 30,
+                        "replica_canary": "off"}),
+        runtime=runtime)
+    group = pipeline.stage_scheduler.groups["detect"]
+    rows = drain(runtime, pump(pipeline, 4), 4)
+    assert all(row[4] for row in rows)
+    pipeline.post_self("fail_replica", ["detect", 0])
+    run_until(runtime,
+              lambda: pipeline.replica_stats()["rebuilds"] >= 1,
+              timeout=30.0)
+    walk = [(slot, state) for slot, state, _ in group.transitions]
+    assert (0, "half_open") not in walk
+    assert group.states == ["live", "live"]
+    pipeline.stop()
+
+
+def test_replica_failover_resets_remote_retry_backoff(runtime):
+    """A frame punished for a dead replica's failures starts clean on
+    a healthy peer: ``remote_retries`` (the exponential-backoff state)
+    resets when the failover re-admits it elsewhere."""
+    pipeline = Pipeline(
+        replicated_definition(replicas=2, busy_ms=60.0,
+                              parameters={"replica_rebuild_ms": 0}),
+        runtime=runtime)
+    responses = pump(pipeline, 4)
+    # Let frames admit onto stage workers.
+    run_until(runtime,
+              lambda: any(frame.stage == "detect"
+                          for stream in pipeline.streams.values()
+                          for frame in stream.frames.values()),
+              timeout=30.0)
+    victims = [frame for stream in pipeline.streams.values()
+               for frame in stream.frames.values()
+               if frame.stage == "detect" and frame.stage_replica == 0]
+    assert victims, "no frame admitted to replica 0"
+    for frame in victims:
+        frame.remote_retries = 3        # poisoned backoff state
+    pipeline.fail_replica("detect", 0)
+    for frame in victims:
+        assert frame.remote_retries == 0
+    rows = drain(runtime, responses, 4)
+    assert all(row[4] for row in rows)
+    pipeline.stop()
+
+
+def test_all_replicas_dead_fails_frames_then_rebuild_recovers(runtime):
+    """Every replica dead and no rebuild pending: incoming frames fail
+    fast (stream stays alive) instead of queueing forever."""
+    pipeline = Pipeline(
+        replicated_definition(replicas=2, busy_ms=5.0,
+                              parameters={"replica_rebuild_ms": 0}),
+        runtime=runtime)
+    rows = drain(runtime, pump(pipeline, 2), 2)
+    assert all(row[4] for row in rows)
+    pipeline.fail_replica("detect", 0)
+    # The LAST replica's failure escalates to an immediate rebuild --
+    # the stage cannot serve at N-0 -- which restores both slots.
+    pipeline.fail_replica("detect", 1)
+    assert pipeline.replica_stats()["rebuilds"] == 1
+    rows2 = drain(runtime, pump(pipeline, 4, stream_id="r2"), 4)
+    assert all(row[4] for row in rows2)
+    pipeline.stop()
+
+
+def test_autoscale_scales_up_on_queue_and_down_on_idle(runtime):
+    pipeline = Pipeline(
+        {"version": 0, "name": "p_autoscale", "runtime": "jax",
+         "graph": ["(detect)"],
+         "elements": [
+             element("detect", "StageWork", ["x"], ["x"],
+                     {"busy_ms": 5.0, "factor": 2.0},
+                     {"devices": 1,
+                      "replicas": {"min": 1, "max": 3}})]},
+        runtime=runtime)
+    placement = pipeline.stage_placement
+    scheduler = pipeline.stage_scheduler
+    group = scheduler.groups["detect"]
+    assert placement.replica_total("detect") == 1   # starts at min
+    # Synthesize load: the one replica ran hot all window and a frame
+    # is queued behind it.
+    group._busy[0] = 10.0
+    group._window_start = time.monotonic() - 10.0
+    scheduler.enqueue("detect", ["s", 0, "detect", True, None])
+    decisions = pipeline.autoscale_replicas()
+    assert decisions == {"detect": 2}
+    assert placement.replica_total("detect") == 2
+    scheduler._waiters["detect"].clear()
+    scheduler.queued["detect"] = 0
+    # Idle window: scale back down toward min.
+    group = scheduler.groups["detect"]
+    decisions = pipeline.autoscale_replicas()
+    assert decisions == {"detect": 1}
+    assert placement.replica_total("detect") == 1
+    # At the floor with no load: no decision.
+    assert pipeline.autoscale_replicas() == {}
+    pipeline.stop()
+
+
+def test_autoscaled_pipeline_serves_through_resplit(runtime):
+    """Frames in flight when the autoscaler re-splits replicas replay
+    onto the fresh carve and deliver in order."""
+    pipeline = Pipeline(
+        {"version": 0, "name": "p_autoscale2", "runtime": "jax",
+         "graph": ["(detect)"],
+         "elements": [
+             element("detect", "StageWork", ["x"], ["x"],
+                     {"busy_ms": 15.0, "factor": 2.0},
+                     {"devices": 1,
+                      "replicas": {"min": 1, "max": 4}})]},
+        runtime=runtime)
+    scheduler = pipeline.stage_scheduler
+    group = scheduler.groups["detect"]
+    responses = pump(pipeline, 10)
+
+    fired = []
+
+    def resplit():
+        if not fired:
+            group._busy[0] = 10.0
+            group._window_start = time.monotonic() - 10.0
+            fired.append(pipeline.autoscale_replicas())
+
+    pipeline.post_self("autoscale_replicas", [], delay=0.04)
+    rows = drain(runtime, responses, 10)
+    assert len(rows) == 10
+    assert all(row[4] for row in rows), \
+        [row[5] for row in rows if not row[4]]
+    order = [row[1] for row in rows]
+    assert order == sorted(order)
+    pipeline.stop()
+
+
+def test_administrative_resplit_does_not_charge_replay_budget(runtime):
+    """Consecutive autoscale re-splits under a sustained backlog must
+    not exhaust ``replay_limit``: the engine's own re-carve is not a
+    failure, so frames replayed by it keep their full recovery budget
+    (regression: with replay_limit 1, two re-splits used to error the
+    whole backlog)."""
+    pipeline = Pipeline(
+        {"version": 0, "name": "p_resplit_budget", "runtime": "jax",
+         "graph": ["(detect)"],
+         "parameters": {"replay_limit": 1},
+         "elements": [
+             element("detect", "StageWork", ["x"], ["x"],
+                     {"busy_ms": 20.0, "factor": 2.0},
+                     {"devices": 1,
+                      "replicas": {"min": 1, "max": 4}})]},
+        runtime=runtime)
+    placement = pipeline.stage_placement
+    scheduler = pipeline.stage_scheduler
+    responses = pump(pipeline, 10)
+    run_until(runtime,
+              lambda: any(frame.stage == "detect"
+                          for stream in pipeline.streams.values()
+                          for frame in stream.frames.values()),
+              timeout=30.0)
+    for _ in range(2):                      # two consecutive up-ticks
+        group = scheduler.groups["detect"]
+        group._busy = [10.0] * len(group.states)
+        group._window_start = time.monotonic() - 10.0
+        scheduler.enqueue("detect", ["s", 99, "detect", True, None])
+        assert pipeline.autoscale_replicas(), "no scale-up decision"
+        scheduler._waiters["detect"].clear()
+        scheduler.queued["detect"] = 0
+    assert placement.replica_total("detect") == 3
+    rows = drain(runtime, responses, 10)
+    assert all(row[4] for row in rows), \
+        [row[5] for row in rows if not row[4]]
+    order = [row[1] for row in rows]
+    assert order == sorted(order)
+    # The budget is intact: no frame consumed a failure replay.
+    for stream in pipeline.streams.values():
+        for frame in stream.frames.values():
+            assert frame.replays == 0
+    pipeline.stop()
+
+
+def test_replicated_stage_with_stage_pipeline_off_recovers(runtime):
+    """``stage_pipeline: off`` disables replica admission, but a dead
+    replica's chips are still dead -- fail_replica must escalate to the
+    full replace path instead of silently leaving a dead submesh in the
+    pool (regression: it used to no-op without a scheduler)."""
+    pipeline = Pipeline(
+        replicated_definition(replicas=2, busy_ms=5.0,
+                              parameters={"stage_pipeline": "off"}),
+        runtime=runtime)
+    assert pipeline.stage_scheduler is None
+    placement = pipeline.stage_placement
+    doomed = placement.replica_devices("detect", 0)
+    rows = drain(runtime, pump(pipeline, 2), 2)
+    assert all(row[4] for row in rows)
+    pipeline.fail_replica("detect", 0)
+    assert placement.generation == 1, "dead replica never recovered"
+    assert not (set(placement.devices) & doomed), \
+        "dead chips still in the pool"
+    rows2 = drain(runtime, pump(pipeline, 3, stream_id="r2"), 3)
+    assert all(row[4] for row in rows2)
+    pipeline.stop()
+
+
+def test_autoscale_skips_scale_up_without_free_capacity(runtime):
+    """A full pool cannot host another fixed-request replica: the
+    control loop must not emit the decision at all -- the reassign
+    would shed the increment straight back while still replaying every
+    in-flight frame, every tick (regression)."""
+    import jax
+    n = len(jax.devices())
+    pipeline = Pipeline(
+        {"version": 0, "name": "p_full_pool", "runtime": "jax",
+         "graph": ["(detect)"],
+         "elements": [
+             element("detect", "StageWork", ["x"], ["x"],
+                     {"busy_ms": 5.0, "factor": 2.0},
+                     {"devices": 1,
+                      "replicas": {"min": n, "max": n + 4}})]},
+        runtime=runtime)
+    placement = pipeline.stage_placement
+    scheduler = pipeline.stage_scheduler
+    group = scheduler.groups["detect"]
+    assert placement.replica_total("detect") == n    # pool exhausted
+    generation = placement.generation
+    # Hot + queued: the up-condition holds, but there is no capacity.
+    group._busy = [10.0] * len(group.states)
+    group._window_start = time.monotonic() - 10.0
+    scheduler.enqueue("detect", ["s", 0, "detect", True, None])
+    assert pipeline.autoscale_replicas() == {}
+    assert placement.generation == generation, \
+        "no-op scale-up still re-carved the placement"
+    pipeline.stop()

@@ -30,6 +30,8 @@ DEFINITION_FIXTURES = {
     "fallback_mismatch.json": "fallback-mismatch",
     "unused_element.json": "unused-element",
     "bad_placement.json": "bad-placement",
+    "bad_replicas.json": "bad-placement",
+    "replicas_on_unplaced.json": "replicas-on-unplaced",
     "placement_remote.json": "placement-remote",
     "bad_parameter.json": "bad-parameter",
     "bad_source.py": "bad-source",

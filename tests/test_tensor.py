@@ -122,6 +122,112 @@ def test_tree_device_put():
     assert placed["x"].sharding.mesh.shape["dp"] == 4
 
 
+# -- replicated stages (ISSUE 7) --------------------------------------------
+
+def test_replica_carve_splits_stage_into_disjoint_submeshes():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"detect": "auto", "llm": 2},
+                     replicas={"detect": 3})
+    subs = placement.replica_plans["detect"]
+    assert len(subs) == 3
+    owned = [d for plan in subs for d in plan.mesh.devices.flat]
+    assert len(owned) == len(set(owned)) == 6   # disjoint, 8 - llm's 2
+    # The whole-stage plan spans every replica's chips as one dp pool.
+    assert set(placement.plans["detect"].mesh.devices.flat) == set(owned)
+    assert placement.live_replicas("detect") == [0, 1, 2]
+    for device in subs[1].mesh.devices.flat:
+        assert placement.replica_of("detect", device) == 1
+
+
+def test_replica_fixed_request_describes_one_replica():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"detect": {"dp": 2}}, replicas={"detect": 3})
+    for plan in placement.replica_plans["detect"]:
+        assert dict(plan.mesh.shape) == {"dp": 2}
+    assert placement.plans["detect"].mesh.devices.size == 6
+
+
+def test_replica_overflow_rejected():
+    placement = StagePlacement(jax.devices())
+    with pytest.raises(ValueError, match="want"):
+        placement.assign({"detect": {"dp": 2}}, replicas={"detect": 5})
+
+
+def test_drop_replica_retires_one_submesh_without_touching_peers():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"detect": "auto"}, replicas={"detect": 4})
+    before = [set(plan.mesh.devices.flat)
+              for plan in placement.replica_plans["detect"]]
+    placement.stage_sharding("detect", replica=0)
+    epoch = placement.replica_epoch
+    dead = placement.drop_replica("detect", 2)
+    assert dead == before[2]
+    # Peers keep their EXACT submeshes -- no generation bump, no
+    # re-carve; only the replica epoch moves (per-replica caches).
+    assert placement.generation == 0
+    assert placement.replica_epoch == epoch + 1
+    for index in (0, 1, 3):
+        assert set(placement.replica_plans["detect"][index]
+                   .mesh.devices.flat) == before[index]
+    assert placement.replica_plans["detect"][2] is None
+    assert placement.live_replicas("detect") == [0, 1, 3]
+    # The dead chips left the pool and the stage-wide plan.
+    assert not set(placement.devices) & dead
+    assert not set(placement.plans["detect"].mesh.devices.flat) & dead
+    # Stage shardings were invalidated (stale submesh memo).
+    assert not placement._shardings
+    # Dropping again is a no-op.
+    assert placement.drop_replica("detect", 2) == set()
+
+
+def test_reassign_restores_desired_replica_count():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"detect": 1}, replicas={"detect": 3},
+                     replica_min={"detect": 1})
+    placement.drop_replica("detect", 1)
+    assert len(placement.live_replicas("detect")) == 2
+    generation = placement.generation
+    placement.reassign()
+    # 8-chip pool minus the retired chip still fits 3x1.
+    assert len(placement.live_replicas("detect")) == 3
+    assert placement.generation == generation + 1
+
+
+def test_replace_sheds_replicas_before_halving_fixed_axes():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"detect": {"dp": 2}, "llm": {"tp": 2}},
+                     replicas={"detect": 3}, replica_min={"detect": 1})
+    # Kill 4 chips: 4 survivors cannot hold 3x2 + 2, so detect sheds
+    # replicas down to 1 (2 chips) before llm's tp axis halves.
+    placement.replace(placement.devices[:4])
+    assert len(placement.live_replicas("detect")) == 1
+    assert dict(placement.plans["llm"].mesh.shape) == {"tp": 2}
+
+
+def test_set_replicas_validates_and_floors():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"detect": 1}, replicas={"detect": 2},
+                     replica_min={"detect": 2})
+    with pytest.raises(KeyError):
+        placement.set_replicas("llm", 3)
+    placement.set_replicas("detect", 1)     # floored at replica_min
+    placement.reassign()
+    assert len(placement.live_replicas("detect")) == 2
+    placement.set_replicas("detect", 4)
+    placement.reassign()
+    assert len(placement.live_replicas("detect")) == 4
+
+
+def test_replica_transfer_lands_on_one_submesh():
+    placement = StagePlacement(jax.devices())
+    placement.assign({"detect": 2}, replicas={"detect": 2})
+    x = jnp.arange(16.0).reshape(4, 4)
+    on_one = placement.transfer(x, "detect", replica=1)
+    assert set(on_one.sharding.device_set) \
+        == placement.replica_devices("detect", 1)
+    np.testing.assert_array_equal(np.asarray(on_one), np.asarray(x))
+
+
 # -- host codec -------------------------------------------------------------
 
 def test_array_codec_roundtrip():
