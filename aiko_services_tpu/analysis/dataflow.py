@@ -18,7 +18,7 @@ one that looks inside element sources.
 from __future__ import annotations
 
 from .findings import Finding
-from .params import validate_parameters
+from .params import validate_element_parameters, validate_parameters
 from ..utils import Graph, GraphError
 
 __all__ = ["analyze_dataflow", "build_graph", "node_path_context"]
@@ -100,6 +100,20 @@ def analyze_dataflow(definition) -> list:
     findings.extend(
         f for f in validate_parameters(definition.parameters, source)
         if disables.active("bad-parameter", None))
+    # Element-level knob domains (ELEMENT_PARAMETERS, keyed by class):
+    # a typo'd ``speculative`` mode or a negative page size fails here
+    # at create time, not at frame N on the device worker.
+    for element in definition.elements:
+        deploy = element.deploy_local or {}
+        class_name = deploy.get("class_name")
+        if not class_name or not element.parameters:
+            continue
+        findings.extend(
+            f for f in validate_element_parameters(
+                class_name, element.parameters,
+                f"{source}: {element.name}",
+                module=deploy.get("module", ""))
+            if disables.active("bad-parameter", element.name))
     # Placement validity itself comes from the ONE shared authority
     # (definition.placement_error), which _build_placement also raises
     # from -- the rule here only adds the lint packaging.

@@ -12,6 +12,14 @@ somewhere -- so the table can neither rot nor drift.
 Element-level parameters (``width``, ``max_new_tokens``, ...) are the
 element author's namespace and deliberately NOT registered here; the
 ``unread-parameter`` residency rule covers those per class.
+
+Exception: the LLM serving element's DOMAIN-constrained knobs
+(``speculative: off|ngram|draft``, page/block sizes -- ISSUE 8) are
+registered in :data:`ELEMENT_PARAMETERS` keyed by element class, so a
+typo'd mode or a negative page size fails at create time under the
+same ``bad-parameter`` rule instead of at frame N on the device
+worker.  Only the registered names are validated; the rest of an
+element's parameter namespace stays free-form.
 """
 
 from __future__ import annotations
@@ -20,7 +28,8 @@ from dataclasses import dataclass
 
 from .findings import Finding
 
-__all__ = ["ParamSpec", "PIPELINE_PARAMETERS", "validate_parameters"]
+__all__ = ["ParamSpec", "PIPELINE_PARAMETERS", "ELEMENT_PARAMETERS",
+           "validate_parameters", "validate_element_parameters"]
 
 
 @dataclass(frozen=True)
@@ -104,11 +113,84 @@ PIPELINE_PARAMETERS: dict[str, ParamSpec] = {
 }
 
 
+#: (module, class) -> {parameter: spec}: the serving knobs with real
+#: value domains (README "LLM serving" documents each).  Validated by
+#: ``validate_element_parameters`` wherever the element's definition
+#: entry carries a parameters block.  Keyed by the deploy module AND
+#: class name so a user's unrelated class that happens to share a
+#: name never has these domains imposed on it (modules normalize
+#: path->dotted, see ``_module_key``).
+ELEMENT_PARAMETERS: dict[tuple[str, str], dict[str, ParamSpec]] = {
+    ("aiko_services_tpu.elements.llm", "LLM"): {
+        "decode_block_tokens": ParamSpec(
+            "device-resident generation: emitted-ring tokens fetched "
+            "per block (0 = host-driven decode)",
+            number=True, minimum=0),
+        "speculative": ParamSpec(
+            "speculative multi-token decoding mode",
+            choices=("off", "ngram", "draft")),
+        "spec_tokens": ParamSpec(
+            "draft tokens proposed per speculative step",
+            number=True, minimum=1),
+        "spec_window": ParamSpec(
+            "recent-token window the ngram draft matches against",
+            number=True, minimum=4),
+        "kv_page_tokens": ParamSpec(
+            "paged KV cache page size in tokens (0 = monolithic)",
+            number=True, minimum=0),
+        "kv_pages": ParamSpec(
+            "physical page-pool size (absent = full provisioning)",
+            number=True, minimum=2),
+        "decode_block": ParamSpec(
+            "fused decode steps per dispatch (host-pipelined path)",
+            number=True, minimum=1),
+        "inflight": ParamSpec(
+            "decode blocks kept in flight, chained device-side",
+            number=True, minimum=1),
+        "max_slots": ParamSpec(
+            "device batch width (concurrent request slots)",
+            number=True, minimum=1),
+    },
+}
+
+
 def _parse_number(value):
     try:
         return float(value)
     except (TypeError, ValueError):
         return None
+
+
+def _check_value(name: str, spec: ParamSpec, value, spot: str) \
+        -> Finding | None:
+    """One value against one spec -> a ``bad-parameter`` finding or
+    None (shared by the pipeline- and element-level validators)."""
+    if spec.choices:
+        normalized = str(value).strip().lower()
+        if normalized not in spec.choices:
+            return Finding(
+                "bad-parameter",
+                f"{name}={value!r}: one of "
+                f"{'|'.join(spec.choices)}", spot)
+        return None
+    if spec.number:
+        number = _parse_number(value)
+        if number is None:
+            return Finding(
+                "bad-parameter",
+                f"{name}={value!r}: expected a number", spot)
+        if spec.minimum is not None and number < spec.minimum:
+            return Finding(
+                "bad-parameter",
+                f"{name}={value!r}: must be >= {spec.minimum:g}", spot)
+        return None
+    if spec.kind == "json" and name == "fault_plan" and value:
+        try:
+            from ..faults import FaultPlan
+            FaultPlan.parse(value)
+        except (ValueError, TypeError) as error:
+            return Finding("bad-parameter", f"fault_plan: {error}", spot)
+    return None
 
 
 def validate_parameters(parameters: dict, where: str) -> list:
@@ -118,33 +200,35 @@ def validate_parameters(parameters: dict, where: str) -> list:
     for name, spec in PIPELINE_PARAMETERS.items():
         if name not in parameters:
             continue
-        value = parameters[name]
-        spot = f"{where}.parameters.{name}"
-        if spec.choices:
-            normalized = str(value).strip().lower()
-            if normalized not in spec.choices:
-                findings.append(Finding(
-                    "bad-parameter",
-                    f"{name}={value!r}: one of "
-                    f"{'|'.join(spec.choices)}", spot))
+        finding = _check_value(name, spec, parameters[name],
+                               f"{where}.parameters.{name}")
+        if finding is not None:
+            findings.append(finding)
+    return findings
+
+
+def _module_key(module) -> str:
+    """Normalize a deploy module reference (dotted name or file path)
+    to the dotted form ELEMENT_PARAMETERS keys use."""
+    module = str(module or "")
+    if module.endswith(".py"):
+        module = module[:-3]
+    return module.replace("/", ".").replace("\\", ".").strip(".")
+
+
+def validate_element_parameters(class_name: str, parameters: dict,
+                                where: str, module: str = "") -> list:
+    """``bad-parameter`` findings for one ELEMENT's parameters block,
+    against the (module, class)-registered knob domains (no-op for
+    classes with nothing registered)."""
+    registry = ELEMENT_PARAMETERS.get(
+        (_module_key(module), class_name), {})
+    findings: list[Finding] = []
+    for name, spec in registry.items():
+        if name not in (parameters or {}):
             continue
-        if spec.number:
-            number = _parse_number(value)
-            if number is None:
-                findings.append(Finding(
-                    "bad-parameter",
-                    f"{name}={value!r}: expected a number", spot))
-            elif spec.minimum is not None and number < spec.minimum:
-                findings.append(Finding(
-                    "bad-parameter",
-                    f"{name}={value!r}: must be >= "
-                    f"{spec.minimum:g}", spot))
-            continue
-        if spec.kind == "json" and name == "fault_plan" and value:
-            try:
-                from ..faults import FaultPlan
-                FaultPlan.parse(value)
-            except (ValueError, TypeError) as error:
-                findings.append(Finding(
-                    "bad-parameter", f"fault_plan: {error}", spot))
+        finding = _check_value(name, spec, parameters[name],
+                               f"{where}.parameters.{name}")
+        if finding is not None:
+            findings.append(finding)
     return findings

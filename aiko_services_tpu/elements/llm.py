@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 
 import jax
 
@@ -204,11 +205,27 @@ class LLM(PipelineElement):
     prefill path, 2.5x dense at 8k context), ``quantize`` (weight-only
     int8: halves decode's HBM stream), ``decode_block`` (fuse N decode
     steps per device dispatch: amortizes host round trips), ``inflight``
-    (keep N fused blocks in flight, chained device-side: hides the
+    (keep N fused/loop blocks in flight, chained device-side: hides the
     dispatch round trip behind device compute), ``max_slots`` (device
     batch width: size to the expected concurrent-frame count; decode is
     weight-HBM-bound at short context, so wider batches decode more
     frames' requests per block at nearly the same step time).
+
+    Device-resident serving (ISSUE 8): ``decode_block_tokens`` > 0
+    moves generation into ``llama.decode_loop`` -- on-device sampling,
+    per-slot stop detection and an emitted-token ring, ONE counted
+    ledger fetch per block (the batcher's ``fetch`` is wired to the
+    pipeline TransferLedger, and the worker runs decode ticks under
+    the ledger's transfer guard, so a stray per-token host sync FAILS
+    under ``transfer_guard: disallow`` instead of silently capping
+    tok/s).  ``speculative: off|ngram|draft`` layers multi-token
+    decoding onto the loop (``spec_tokens`` drafts per step);
+    ``kv_page_tokens`` > 0 switches the KV cache to fixed-size pages
+    with a per-slot page table (``kv_pages`` caps the physical pool).
+    A device loss mid-generation (or a chaos ``decode_block`` fault)
+    replays every live request from its last emitted block: the
+    batcher re-prefills prompt + committed tokens and generation
+    continues -- nothing already streamed is re-emitted.
 
     ASYNC by default: each frame parks and its request hops to the
     element's device WORKER THREAD, which owns the model and the shared
@@ -242,13 +259,22 @@ class LLM(PipelineElement):
         # process_frame path (a per-stream ``synchronous: true`` can
         # run while another stream uses the async worker).
         self._device_lock = threading.RLock()
+        # Device-loss recovery bookkeeping: consecutive failed decode
+        # ticks before the worker gives up replaying (reset by any
+        # successful tick), and the telemetry counters' published
+        # watermarks (deltas feed the registry).
+        self._recover_streak = 0
+        self._published_accepted = 0
+        self._published_drafted = 0
 
     # Model-config parameters, resolved ON THE EVENT LOOP (stream
     # parameter precedence reads the pipeline's current-stream context,
     # which only the loop thread maintains) and shipped to the worker.
     _MODEL_PARAMS = ("checkpoint", "tokenizer", "vocab_size", "max_seq",
                      "seed", "attention", "model", "quantize",
-                     "decode_block", "inflight", "max_slots")
+                     "decode_block", "inflight", "max_slots",
+                     "decode_block_tokens", "speculative", "spec_tokens",
+                     "spec_window", "kv_page_tokens", "kv_pages")
 
     def _resolve_model_params(self) -> dict:
         resolved = {}
@@ -313,12 +339,26 @@ class LLM(PipelineElement):
             raise ValueError(
                 f"quantize={quantize!r}: use true/false or int8")
         # Requests beyond max_slots queue (sizing rationale: class
-        # docstring).
+        # docstring).  The pipeline TransferLedger counts the one
+        # explicit host fetch each retired device-loop block pays; the
+        # chaos probe arms the ``decode_block`` injection point.
+        ledger = self._ledger()
+        kv_pages = settings.get("kv_pages")
         self._batcher = ContinuousBatcher(
             params, config,
             max_slots=int(settings.get("max_slots", 8)),
             decode_block=int(settings.get("decode_block", 1)),
-            inflight=int(settings.get("inflight", 2)))
+            inflight=int(settings.get("inflight", 2)),
+            decode_block_tokens=int(
+                settings.get("decode_block_tokens", 0)),
+            speculative=str(settings.get("speculative", "off")),
+            spec_tokens=int(settings.get("spec_tokens", 4)),
+            spec_window=int(settings.get("spec_window", 32)),
+            kv_page_tokens=int(settings.get("kv_page_tokens", 0)),
+            kv_pages=None if kv_pages is None else int(kv_pages),
+            fetch=None if ledger is None
+            else (lambda tree: ledger.fetch(tree, label="llm_block")),
+            fault_probe=self._fault_probe)
 
     def _make_request(self, stream_id, text,
                       request_params: dict) -> tuple[Request, list[int]]:
@@ -407,6 +447,101 @@ class LLM(PipelineElement):
             except queue.Empty:
                 return
 
+    def _ledger(self):
+        """The pipeline's TransferLedger (None outside a pipeline --
+        direct construction in tests)."""
+        return getattr(getattr(self, "pipeline", None),
+                       "transfer_ledger", None)
+
+    def _fault_probe(self, point: str):
+        """Chaos injection point ``decode_block`` (faults/plan.py):
+        consulted by the batcher before every device-loop block
+        dispatch.  A matched rule with ``delay_ms`` hangs the
+        dispatch; without, it raises FaultInjected standing in for the
+        XLA error a dying chip surfaces mid-generation -- driving the
+        same recovery path (``ContinuousBatcher.recover``)."""
+        plan = getattr(getattr(self, "pipeline", None), "_faults", None)
+        if plan is None:
+            return
+        rule = plan.should(point, target=self.name)
+        if rule is None:
+            return
+        if rule.delay_ms:
+            time.sleep(rule.delay_ms / 1000.0)
+            return
+        from ..faults import FaultInjected
+        raise FaultInjected(
+            f"{point} kill injected at {self.name}")
+
+    def _tick(self, batcher):
+        """One batcher step.  Device-loop ticks run under the
+        transfer-ledger guard: on hardware backends a stray per-token
+        device-to-host sync then RAISES under ``transfer_guard:
+        disallow`` -- the batcher's only legal host read is the ledger-
+        counted per-block fetch it was built with."""
+        ledger = self._ledger()
+        if batcher.device_loop and ledger is not None:
+            with ledger.guard():
+                batcher.step()
+        else:
+            batcher.step()
+        self._recover_streak = 0
+        self._publish_serving_stats(batcher)
+
+    def _recover(self, batcher, error) -> bool:
+        """Replay-from-last-emitted-block after a device-level failure:
+        rebuild the cache/page pool and re-queue every live request at
+        its committed prefix (ContinuousBatcher.recover).  Gives up --
+        letting the worker's error path fail the parked frames -- on
+        the THIRD consecutive failed tick (a persistently dying
+        device), resetting the streak so the next workload gets its
+        own replay attempts."""
+        self._recover_streak += 1
+        if self._recover_streak > 2:
+            self._recover_streak = 0
+            return False
+        revived = batcher.recover()
+        self.logger.warning(
+            "LLM decode failed (%s); replaying %d request(s) from "
+            "their last emitted block", error, revived)
+        telemetry = getattr(self.pipeline, "telemetry", None)
+        if telemetry is not None:
+            telemetry.registry.count("llm_loop_recoveries")
+        return True
+
+    def _publish_serving_stats(self, batcher):
+        """Per-request latency histograms + speculation counters into
+        the telemetry plane (registry is thread-safe; share updates
+        marshal onto the event loop)."""
+        telemetry = getattr(self.pipeline, "telemetry", None)
+        stats = batcher.take_request_stats()
+        if telemetry is not None:
+            for entry in stats:
+                telemetry.registry.observe("llm_ttft_ms",
+                                           entry["ttft_ms"])
+                if entry["tokens"] > 1:
+                    telemetry.registry.observe("llm_tpot_ms",
+                                               entry["tpot_ms"])
+        accepted = batcher.accepted_tokens
+        drafted = batcher.draft_tokens
+        if accepted == self._published_accepted \
+                and drafted == self._published_drafted:
+            return
+        if telemetry is not None:
+            telemetry.registry.count(
+                "llm_accepted_tokens",
+                accepted - self._published_accepted)
+            telemetry.registry.count(
+                "llm_draft_tokens", drafted - self._published_drafted)
+        self._published_accepted = accepted
+        self._published_drafted = drafted
+        pipeline = self.pipeline
+
+        def update_share():
+            pipeline.ec_producer.update("llm_accepted_tokens", accepted)
+            pipeline.ec_producer.update("llm_draft_tokens", drafted)
+        pipeline.runtime.engine.post_deferred(update_share)
+
     def _worker(self, work: "queue.Queue"):
         """Owns every device interaction: lazy model build, admission,
         the decode loop, retire fetches.  Blocks on the queue while
@@ -423,7 +558,15 @@ class LLM(PipelineElement):
                     while batcher is not None and (
                             batcher.active_count or batcher.queue_depth
                             or batcher.blocks_in_flight):
-                        batcher.step()
+                        try:
+                            self._tick(batcher)
+                        except Exception as error:
+                            # Device loss mid-generation: replay every
+                            # live request from its last emitted block
+                            # (ISSUE 8) before the error path below
+                            # gets to fail the parked frames.
+                            if not self._recover(batcher, error):
+                                raise
                         self._drain_work(work)
                 except Exception as error:
                     # A failing decode tick must FAIL the parked frames,

@@ -33,6 +33,12 @@ Injection points (the ``point`` field of a rule):
 ``stage_stall``           occupy a placed stage's FIFO worker ``delay_ms``
 ``device_kill``           health prober reports the target's chips dead
 ``device_hang``           health prober hangs ``delay_ms`` on the target
+``decode_block``          the LLM element's device-resident generation
+                          loop, probed before every block dispatch:
+                          without ``delay_ms`` it raises (a chip dying
+                          MID-GENERATION -- the batcher replays every
+                          live request from its last emitted block);
+                          with ``delay_ms`` it hangs the dispatch
 ``wire_drop``             drop a ``process_frame``/``_response`` message
 ``wire_delay``            deliver it ``delay_ms`` late
 ``wire_dup``              deliver it twice
@@ -62,7 +68,7 @@ _logger = get_logger("aiko.faults")
 
 POINTS = frozenset({
     "element_raise", "element_hang", "segment_fail", "stage_stall",
-    "device_kill", "device_hang",
+    "device_kill", "device_hang", "decode_block",
     "wire_drop", "wire_delay", "wire_dup", "wire_corrupt",
 })
 

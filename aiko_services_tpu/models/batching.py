@@ -32,6 +32,22 @@ token streams.
   asynchronously and retired one block behind.  A request's tokens past
   its EOS/budget inside in-flight blocks are discarded host-side (the
   same overshoot semantics a single fused block already had);
+- with ``decode_block_tokens > 0`` (ISSUE 8) generation is DEVICE
+  RESIDENT: ``step()`` dispatches ``llama.decode_loop`` blocks -- a
+  ``lax.while_loop`` with on-device sampling, per-slot stop detection
+  (EOS + budget + cache boundary) and an emitted-token ring in the
+  carry -- and the host pays ONE counted fetch per retired block (the
+  ``fetch`` hook, wired to the pipeline's TransferLedger by the LLM
+  element) instead of one round trip per token.  Admission and
+  eviction happen only at block boundaries; ``speculative:
+  ngram|draft`` layers multi-token decoding onto the loop with
+  acceptance bookkeeping entirely on-device;
+- with ``kv_page_tokens > 0`` the KV cache is PAGED (models/paged.py):
+  slots borrow fixed-size pages from a shared pool as their sequences
+  actually grow, a finished/evicted slot returns them, and a pool
+  under pressure preempts the youngest slot (its generation resumes
+  later from its committed tokens -- the same resume path
+  :meth:`ContinuousBatcher.recover` uses after a device loss);
 - the engine is synchronous and thread-agnostic: ``step()`` advances one
   tick and invokes per-request ``emit`` callbacks.  The serving element
   runs it on the event engine and pushes tokens to actor queues.
@@ -42,6 +58,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from collections import deque
 from functools import partial
 from typing import Callable
@@ -51,6 +68,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import llama
+from .paged import PageAllocator, init_paged_cache, pages_per_slot
+from .quant import draft_params
 from ..utils.misc import next_power_of_two
 
 __all__ = ["Request", "ContinuousBatcher", "MicroBatcher",
@@ -86,6 +105,18 @@ class Request:
     prefill_pos: int = 0             # prompt tokens already written
     generated: int = 0
     done: bool = False
+    # resume state: the submitted prompt, every token emitted so far,
+    # and how many of those have been folded back into prompt_tokens
+    # (recover()/page-pool preemption re-prefill prompt + committed and
+    # keep generating -- already-delivered tokens are never re-emitted,
+    # and ``rebased`` keeps the budget/boundary arithmetic honest).
+    base_prompt: list = dataclasses.field(default_factory=list)
+    committed: list = dataclasses.field(default_factory=list)
+    rebased: int = 0
+    admit_seq: int = -1              # admission order (eviction picks
+    #                                  the youngest victim)
+    submit_time: float = 0.0         # llm_ttft_ms / llm_tpot_ms stamps
+    first_time: float = 0.0
 
 
 _select_tokens = jax.jit(llama.select_tokens)
@@ -104,12 +135,31 @@ class _InflightBlock:
         self.steps = steps
 
 
+class _LoopBlock:
+    """One dispatched-but-unretired device-resident generation block
+    (llama.decode_loop).  ``tree`` holds every device array the retire
+    needs -- emitted ring, counts, carries, accept counters, folded
+    first tokens -- fetched in ONE counted host copy."""
+    __slots__ = ("tree", "snapshot", "firsts_meta")
+
+    def __init__(self, tree, snapshot, firsts_meta):
+        self.tree = tree
+        self.snapshot = snapshot      # [(slot, request)] in the block
+        self.firsts_meta = firsts_meta  # [(slot, request)] admissions
+
+
 class ContinuousBatcher:
     def __init__(self, params, config: llama.LlamaConfig,
                  max_slots: int = 8, max_seq: int | None = None,
                  prefill_chunk: int = 512, rng_seed: int = 0,
                  decode_block: int = 1, inflight: int = 2,
-                 cache_put: Callable | None = None):
+                 cache_put: Callable | None = None,
+                 decode_block_tokens: int = 0,
+                 speculative: str = "off", spec_tokens: int = 4,
+                 spec_window: int = 32, kv_page_tokens: int = 0,
+                 kv_pages: int | None = None,
+                 fetch: Callable | None = None,
+                 fault_probe: Callable | None = None):
         self.params = params
         self.config = config
         self.max_slots = max_slots
@@ -125,7 +175,57 @@ class ContinuousBatcher:
         # carries, so depth d hides up to d * block_compute of host
         # round-trip latency behind device work.
         self.inflight = max(1, int(inflight))
-        self.cache = llama.init_cache(config, max_slots, self.max_seq)
+        # Device-resident generation (ISSUE 8): > 0 sizes the emitted
+        # ring of llama.decode_loop blocks -- sampling, stop detection
+        # and (optionally) speculation run inside one dispatch, the
+        # host fetches once per block.  Supersedes decode_block when
+        # set.
+        self.decode_block_tokens = max(0, int(decode_block_tokens))
+        self.device_loop = self.decode_block_tokens > 0
+        # Normalized exactly as the create-time domain check
+        # (analysis/params.py _check_value) normalizes, so a value
+        # that passes preflight cannot fail here on case/whitespace.
+        self.speculative = str(speculative or "off").strip().lower()
+        if self.speculative not in ("off", "ngram", "draft"):
+            raise ValueError(f"speculative={speculative!r}: one of "
+                             f"off|ngram|draft")
+        if self.speculative != "off" and not self.device_loop:
+            raise ValueError(
+                "speculative decoding rides the device loop: set "
+                "decode_block_tokens > 0")
+        self.spec_tokens = max(1, int(spec_tokens))
+        if self.speculative != "off" \
+                and self.decode_block_tokens < self.spec_tokens + 1:
+            # The loop's room test needs one worst-case speculative
+            # emission (spec_tokens + 1) to fit the ring; a smaller
+            # ring would dispatch blocks that run ZERO iterations --
+            # a silent no-progress wedge, so refuse it up front.
+            raise ValueError(
+                f"decode_block_tokens={self.decode_block_tokens} "
+                f"cannot hold one speculative emission (spec_tokens + "
+                f"1 = {self.spec_tokens + 1}); raise the ring or "
+                f"lower spec_tokens")
+        self.spec_window = max(4, int(spec_window))
+        self._draft = draft_params(params) \
+            if self.speculative == "draft" else None
+        # Paged KV cache (models/paged.py): fixed-size pages + per-slot
+        # page table; 0 keeps the monolithic [slots, max_seq] cache.
+        self.kv_page_tokens = max(0, int(kv_page_tokens))
+        self._pages: PageAllocator | None = None
+        if self.kv_page_tokens:
+            pps = pages_per_slot(self.max_seq, self.kv_page_tokens)
+            if self.prefill_chunk % self.kv_page_tokens:
+                raise ValueError(
+                    f"kv_page_tokens={self.kv_page_tokens} must divide "
+                    f"prefill_chunk ({self.prefill_chunk}) so admission "
+                    f"chunks stay page-aligned")
+            self.cache = init_paged_cache(
+                config, max_slots, self.max_seq, self.kv_page_tokens,
+                kv_pages)
+            pool = llama.cache_array(self.cache).shape[1]
+            self._pages = PageAllocator(pool, pps, max_slots)
+        else:
+            self.cache = llama.init_cache(config, max_slots, self.max_seq)
         # Multichip serving: ``cache_put`` places the initial KV cache
         # onto the serving mesh (e.g. ``lambda c: plan.put(c,
         # llama.cache_specs(config))`` for TP-sharded kv heads) --
@@ -133,8 +233,16 @@ class ContinuousBatcher:
         # so one placement at init is enough.  Params are pre-sharded by
         # the caller the same way (quantized trees via
         # quant.quantize_specs).
+        self._cache_put = cache_put
         if cache_put is not None:
             self.cache = cache_put(self.cache)
+        # One explicit host fetch per retired device-loop block; the
+        # LLM element wires the pipeline TransferLedger's counted fetch
+        # here so serving obeys the device-resident swag contract.
+        self._fetch = fetch if fetch is not None else jax.device_get
+        # Armed-chaos probe called before every device-loop block
+        # dispatch (the ``decode_block`` injection point); None = cold.
+        self._fault_probe = fault_probe
         self.lengths = np.zeros(max_slots, dtype=np.int32)
         self.current = np.zeros(max_slots, dtype=np.int32)
         self.temperatures = np.zeros(max_slots, dtype=np.float32)
@@ -153,10 +261,33 @@ class ContinuousBatcher:
         self._temps_dev = None
         self._pending_first: dict[int, tuple] = {}   # slot -> (req, dev)
         self._inflight: deque[_InflightBlock] = deque()
+        # device-loop state: the chained carries of the latest loop
+        # block, the in-flight loop-block queue, host mirrors of
+        # per-slot eos rows and a conservative length upper bound for
+        # page allocation while blocks are in flight.
+        self._loop_chain: dict | None = None
+        self._loop_inflight: deque[_LoopBlock] = deque()
+        self._eos_width = 1
+        self._eos_rows = np.full((max_slots, 1), -1, dtype=np.int32)
+        self._lengths_upper = np.zeros(max_slots, dtype=np.int32)
+        self._admit_seq = 0
+        # Slots whose chained ``active`` flag must drop at the next
+        # dispatch (host-side finish/cancel/eviction the device hasn't
+        # seen yet).
+        self._force_inactive: set[int] = set()
         # perf counters
         self.tokens_emitted = 0
         self.steps = 0
         self.prefill_tokens = 0
+        self.blocks_dispatched = 0
+        self.blocks_retired = 0
+        self.accepted_tokens = 0
+        self.draft_tokens = 0
+        self.evictions = 0
+        self.recoveries = 0
+        # per-request latency stamps drained by the serving element
+        # into the telemetry plane (llm_ttft_ms / llm_tpot_ms).
+        self._request_stats: list[dict] = []
 
     # -- admission ---------------------------------------------------------
 
@@ -169,6 +300,8 @@ class ContinuousBatcher:
         # into uninitialised padding.
         if not request.prompt_tokens:
             request.prompt_tokens = [0]
+        request.base_prompt = list(request.prompt_tokens)
+        request.submit_time = time.perf_counter()
         self.pending.append(request)
 
     def _admit(self):
@@ -180,13 +313,32 @@ class ContinuousBatcher:
             request = self.pending.pop(0)
             request.slot = slot
             request.prefill_pos = 0
+            request.admit_seq = self._admit_seq
+            self._admit_seq += 1
             self.slots[slot] = request
             self.lengths[slot] = 0
+            self._lengths_upper[slot] = 0
             self.current[slot] = 0
             self.temperatures[slot] = request.temperature
             self._temps_dev = None
             self.decoding[slot] = False
+            self._set_eos_row(slot, request.eos_tokens)
             self._prefilling.append(slot)
+
+    def _set_eos_row(self, slot: int, eos_tokens) -> None:
+        """Mirror one slot's stop-token set into the host eos table
+        (uploaded with every device-loop dispatch; -1 pads never match
+        a real token id).  A wider set than any seen before grows the
+        table -- a new compile shape, once per distinct width."""
+        width = max(1, len(eos_tokens or ()))
+        if width > self._eos_width:
+            grown = np.full((self.max_slots, width), -1, dtype=np.int32)
+            grown[:, :self._eos_width] = self._eos_rows
+            self._eos_rows = grown
+            self._eos_width = width
+        self._eos_rows[slot] = -1
+        for column, token in enumerate(eos_tokens or ()):
+            self._eos_rows[slot, column] = int(token)
 
     def _prefill_tick(self):
         """Advance admissions by one chunk (<= prefill_chunk tokens)
@@ -198,18 +350,25 @@ class ContinuousBatcher:
         Synchronous path (decode_block == 1): at most ONE chunk total,
         preserving the one-chunk decode-stall bound (each chunk's
         completion fetch blocks the host there)."""
-        if (self.decode_block > 1 and len(self._prefilling) > 1
+        pipelined = self.decode_block > 1 or self.device_loop
+        if (pipelined and len(self._prefilling) > 1
                 and self.config.attention != "flash"):
             self._prefill_tick_batched()
             return
-        budget = len(self._prefilling) if self.decode_block > 1 \
+        budget = len(self._prefilling) if pipelined \
             else min(1, len(self._prefilling))
         for _ in range(budget):
+            if not self._prefilling:
+                break           # shrunk by a pressure eviction below
             slot = self._prefilling.pop(0)
             request = self.slots[slot]
-            if request is None:                 # cancelled while waiting
+            if request is None:     # cancelled/evicted while waiting
                 continue
             start, chunk_tokens = self._admission_chunk(request)
+            if not self._ensure_pages(slot, start + self.prefill_chunk):
+                self._prefilling.append(slot)   # pool pressure: wait
+                continue
+            self._sync_page_table()
             padded = np.zeros((1, self.prefill_chunk), dtype=np.int32)
             padded[0, :len(chunk_tokens)] = chunk_tokens
             logits, self.cache = llama.prefill_into_slot(
@@ -225,15 +384,26 @@ class ContinuousBatcher:
         same tokens -- see llama.prefill_into_slots)."""
         admitting = []
         for _ in range(len(self._prefilling)):
+            if not self._prefilling:
+                break           # shrunk by a pressure eviction below
             slot = self._prefilling.pop(0)
-            if self.slots[slot] is not None:    # else: cancelled
-                admitting.append(slot)
+            if self.slots[slot] is None:    # cancelled/evicted
+                continue
+            start, _ = self._admission_chunk(self.slots[slot])
+            if not self._ensure_pages(slot, start + self.prefill_chunk):
+                self._prefilling.append(slot)   # pool pressure: wait
+                continue
+            admitting.append(slot)
+        # A LATER slot's ensure may have preempted an EARLIER admitted
+        # one for its pages: drop evicted slots before dispatching.
+        admitting = [s for s in admitting if self.slots[s] is not None]
         # Overflow waits one tick (FIFO rotation keeps chunk fairness);
         # see _ADMISSION_BURST_MAX for why the burst is capped.
         self._prefilling.extend(admitting[_ADMISSION_BURST_MAX:])
         admitting = admitting[:_ADMISSION_BURST_MAX]
         if not admitting:
             return
+        self._sync_page_table()
         n = len(admitting)
         rows = pad_to_bucket(admitting)
         bucket = len(rows)
@@ -289,9 +459,10 @@ class ContinuousBatcher:
         last = len(prompt) - start - 1
         first = self._sample(logits[:, last, :], request.temperature)
         self.lengths[slot] = len(prompt)
+        self._lengths_upper[slot] = len(prompt)
         self.decoding[slot] = True
         self._active_dev = None
-        if self.decode_block > 1:
+        if self.device_loop or self.decode_block > 1:
             # No host copy here: the retire fetches the CONCATENATED
             # firsts array of the block this admission folds into.
             self._pending_first[slot] = (request, first)
@@ -316,6 +487,14 @@ class ContinuousBatcher:
         self._admit()
         self._prefill_tick()
         decoding = [i for i in range(self.max_slots) if self.decoding[i]]
+        if self.device_loop:
+            if decoding or self._pending_first or self._loop_inflight:
+                while len(self._loop_inflight) < self.inflight:
+                    if not self._dispatch_loop_block():
+                        break
+                if self._loop_inflight:
+                    self._retire_loop_block()
+            return sum(1 for r in self.slots if r is not None)
         if self.decode_block > 1:
             if decoding:
                 # Top the pipeline up to `inflight` blocks, then retire
@@ -331,7 +510,8 @@ class ContinuousBatcher:
                 while (len(self._inflight) < self.inflight
                        and len(self._inflight) * self.decode_block
                        < remaining):
-                    self._dispatch_block(decoding)
+                    if self._dispatch_block(decoding) is False:
+                        break
             if self._inflight:
                 self._retire_block()
         elif decoding:
@@ -339,6 +519,21 @@ class ContinuousBatcher:
         return sum(1 for r in self.slots if r is not None)
 
     def _decode_tick(self, decoding: list[int]):
+        if self._pages is not None:
+            for slot in decoding:
+                if not self._ensure_pages(slot,
+                                          int(self.lengths[slot]) + 2):
+                    # Unreachable while the pool holds one full slot
+                    # (pps + 1, enforced at init): preempt the slot
+                    # itself rather than let its write land on the
+                    # trash page (it resumes from committed tokens).
+                    self._evict_slot(slot)
+            self._sync_page_table()
+            # An ensure may have preempted another decoding slot:
+            # refresh the list (and the write mask reads the flags).
+            decoding = [i for i in decoding if self.decoding[i]]
+            if not decoding:
+                return
         tokens = jnp.asarray(self.current)
         # Rows not decoding (empty or mid-prefill) still flow through the
         # batched step; route their KV write to the trash position
@@ -356,6 +551,8 @@ class ContinuousBatcher:
         self.steps += 1
         for i in decoding:
             request = self.slots[i]
+            if request is None:                 # freed mid-dispatch
+                continue
             self.lengths[i] += 1
             token = int(next_tokens[i])
             self.current[i] = token
@@ -367,6 +564,13 @@ class ContinuousBatcher:
         lengths come from the chain (with prefill-completion overrides
         applied on device), the key chains through the kernel, and the
         emitted tokens start copying to the host asynchronously."""
+        if self._pages is not None:
+            for slot in decoding:
+                if not self._ensure_pages(
+                        slot, int(self._lengths_upper[slot])
+                        + self.decode_block + 1):
+                    return False        # retire in-flight blocks first
+            self._sync_page_table()
         if self._chain is None:
             tokens = jnp.asarray(self.current)
             lengths = jnp.asarray(self.lengths)
@@ -404,6 +608,10 @@ class ContinuousBatcher:
         for i in decoding:                      # host mirror (clamped)
             self.lengths[i] = min(self.lengths[i] + self.decode_block,
                                   self.max_seq - 1)
+        for i in decoding:
+            self._lengths_upper[i] = min(
+                int(self._lengths_upper[i]) + self.decode_block,
+                self.max_seq)
         self._inflight.append(_InflightBlock(
             emitted, [(i, self.slots[i]) for i in decoding], firsts,
             self.decode_block))
@@ -436,14 +644,303 @@ class ContinuousBatcher:
                 self.current[slot] = token
                 self._emit(request, token)
 
+    # -- device-resident generation loop (ISSUE 8) -------------------------
+
+    def _host_state(self):
+        """Fresh device carries from the host mirrors (first dispatch
+        and post-recover; every later block chains device-side)."""
+        self._key, loop_key = jax.random.split(self._key)
+        history_width = self.spec_window \
+            if self.speculative == "ngram" else 1
+        return {
+            "tokens": jnp.asarray(self.current),
+            "lengths": jnp.asarray(self.lengths),
+            "active": jnp.zeros(self.max_slots, dtype=bool),
+            "budget": jnp.zeros(self.max_slots, dtype=jnp.int32),
+            "history": jnp.full((self.max_slots, history_width), -1,
+                                dtype=jnp.int32),
+            "key": loop_key,
+        }
+
+    def _dispatch_loop_block(self) -> bool:
+        """Chain one llama.decode_loop block off the previous block's
+        device carries, folding completed admissions in (their first
+        token, budget, stop set and draft history ride device-side --
+        no host round trip).  Returns False when there is nothing to
+        decode, outstanding blocks already cover every request's
+        budget, or page-pool pressure wants the in-flight blocks
+        retired before an eviction can free room."""
+        ring = self.decode_block_tokens
+        spec_extra = self.spec_tokens + 1 \
+            if self.speculative != "off" else 1
+        live = [i for i in range(self.max_slots) if self.decoding[i]]
+        joining = sorted(self._pending_first)
+        if not live and not joining:
+            return False
+        if not joining and self._loop_inflight:
+            # Outstanding blocks already cover every live request's
+            # remaining budget (EOS may cut a row shorter -- the loop's
+            # own stop detection idles it, so overshoot blocks cost
+            # almost nothing device-side).
+            remaining = max(
+                (self.slots[i].max_new_tokens - self.slots[i].generated
+                 for i in live if self.slots[i] is not None), default=0)
+            if len(self._loop_inflight) * ring >= remaining:
+                return False
+        for slot in sorted({*live, *joining}):
+            if self.slots[slot] is None:
+                continue                # evicted by an earlier ensure
+            upto = int(self._lengths_upper[slot]) + ring + spec_extra
+            if not self._ensure_pages(slot, upto):
+                return False            # retire in-flight blocks first
+        # An ensure above may have PREEMPTED a just-admitted slot for
+        # its pages (the youngest occupant is usually a joining one):
+        # re-snapshot both lists so the fold-in below never touches an
+        # evicted slot's popped _pending_first entry.
+        live = [i for i in range(self.max_slots) if self.decoding[i]]
+        joining = sorted(self._pending_first)
+        if not live and not joining:
+            return False
+        if self._fault_probe is not None:
+            self._fault_probe("decode_block")
+        state = self._loop_chain or self._host_state()
+        tokens, lengths = state["tokens"], state["lengths"]
+        active, budget = state["active"], state["budget"]
+        history, key = state["history"], state["key"]
+        for slot in self._force_inactive:
+            active = active.at[slot].set(False)
+        self._force_inactive.clear()
+        eos_dev = jnp.asarray(self._eos_rows)
+        temps_dev = jnp.asarray(self.temperatures)
+        firsts_meta, first_vals = [], []
+        for slot in joining:
+            request, first = self._pending_first.pop(slot)
+            plen = len(request.prompt_tokens)
+            tokens = tokens.at[slot].set(first[0])
+            lengths = lengths.at[slot].set(plen)
+            budget = budget.at[slot].set(
+                request.max_new_tokens - request.generated - 1)
+            # The slot decodes on unless its FIRST token already
+            # finishes it; the EOS part of that verdict folds in
+            # device-side (the first token is an unfetched scalar).
+            if (request.max_new_tokens - request.generated > 1
+                    and plen + 1 < self.max_seq):
+                active = active.at[slot].set(
+                    jnp.logical_not((first[0] == eos_dev[slot]).any()))
+            else:
+                active = active.at[slot].set(False)
+            if self.speculative == "ngram":
+                tail = np.full(self.spec_window, -1, dtype=np.int32)
+                recent = request.prompt_tokens[-self.spec_window:]
+                tail[len(tail) - len(recent):] = recent
+                history = history.at[slot].set(jnp.asarray(tail))
+            firsts_meta.append((slot, request))
+            first_vals.append(first)
+        self._sync_page_table()
+        (emitted, counts, tokens_next, lengths_next, active_next,
+         budget_next, history_next, key_next, accepted, drafted, steps,
+         self.cache) = llama.decode_loop(
+            self.params, self.config, tokens, self.cache, lengths,
+            active, budget, temps_dev, eos_dev, history, key,
+            ring=ring, speculative=self.speculative,
+            spec_tokens=self.spec_tokens, draft=self._draft)
+        # Only what the retire actually reads rides the counted fetch
+        # (the active/budget/history carries chain device-side).
+        tree = {"emitted": emitted, "counts": counts,
+                "lengths": lengths_next,
+                "accepted": accepted, "drafted": drafted, "steps": steps}
+        if first_vals:
+            tree["firsts"] = jnp.concatenate(first_vals)
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()   # overlap newer blocks
+        self._loop_chain = {"tokens": tokens_next,
+                            "lengths": lengths_next,
+                            "active": active_next, "budget": budget_next,
+                            "history": history_next, "key": key_next}
+        snapshot = sorted({*live, *joining})
+        for slot in snapshot:
+            self._lengths_upper[slot] = min(
+                int(self._lengths_upper[slot]) + ring, self.max_seq)
+        self._loop_inflight.append(_LoopBlock(
+            tree, [(i, self.slots[i]) for i in snapshot], firsts_meta))
+        self.blocks_dispatched += 1
+        return True
+
+    def _retire_loop_block(self):
+        """Fetch the OLDEST in-flight loop block -- ONE counted host
+        copy of its whole result tree (the ``fetch`` hook; the async
+        copies have been overlapping newer blocks' compute) -- and
+        de-multiplex: folded first tokens, then each slot's ring
+        prefix.  The host-side finish test in ``_emit`` is the
+        authority; the device's stop detection never stops a row
+        EARLIER than it, so truncation here only ever discards
+        overshoot."""
+        blk = self._loop_inflight.popleft()
+        fetched = self._fetch(blk.tree)
+        emitted = np.asarray(fetched["emitted"])
+        counts = np.asarray(fetched["counts"])
+        self.steps += int(fetched["steps"])
+        self.blocks_retired += 1
+        self.accepted_tokens += int(np.asarray(fetched["accepted"]).sum())
+        self.draft_tokens += int(np.asarray(fetched["drafted"]).sum())
+        if "firsts" in fetched:
+            first_tokens = np.asarray(fetched["firsts"])
+            for (slot, request), token in zip(blk.firsts_meta,
+                                              first_tokens):
+                if self.slots[slot] is request and not request.done:
+                    token = int(token)
+                    self.current[slot] = token
+                    self._emit(request, token)
+        for slot, request in blk.snapshot:
+            if request is None or self.slots[slot] is not request:
+                continue
+            for index in range(int(counts[slot])):
+                if self.slots[slot] is not request or request.done:
+                    break
+                token = int(emitted[slot, index])
+                self.current[slot] = token
+                self._emit(request, token)
+        lengths_fetched = np.asarray(fetched["lengths"])
+        for slot, request in blk.snapshot:
+            if request is not None and self.slots[slot] is request \
+                    and not request.done:
+                self.lengths[slot] = int(lengths_fetched[slot])
+        if not self._loop_inflight:
+            self._lengths_upper = self.lengths.copy()
+
+    # -- paged-cache bookkeeping -------------------------------------------
+
+    def _ensure_pages(self, slot: int, upto_tokens: int) -> bool:
+        """Cover the slot's logical positions [0, upto_tokens) with
+        physical pages.  Under pool pressure: with blocks in flight the
+        caller must retire them first (their writes still route through
+        the already-dispatched table), otherwise the YOUNGEST other
+        occupant is preempted -- its generation resumes later from its
+        committed tokens, exactly like :meth:`recover`."""
+        if self._pages is None:
+            return True
+        pages = self._pages.pages_for(
+            min(int(upto_tokens), self.max_seq), self.kv_page_tokens)
+        if self._pages.ensure(slot, pages):
+            return True
+        if self._inflight or self._loop_inflight:
+            return False
+        while True:
+            victims = [(occupant.admit_seq, index)
+                       for index, occupant in enumerate(self.slots)
+                       if occupant is not None and index != slot]
+            if not victims:
+                return False
+            self._evict_slot(max(victims)[1])
+            if self._pages.ensure(slot, pages):
+                return True
+
+    def _sync_page_table(self) -> None:
+        """Fold the allocator's dirty rows into the device page table
+        (tiny int32 uploads that ride the next dispatch)."""
+        if self._pages is None or not self._pages.dirty:
+            return
+        table = self.cache["page_table"]
+        for slot, row in self._pages.dirty.items():
+            table = table.at[slot].set(
+                jnp.asarray(row, dtype=jnp.int32))
+        self._pages.dirty.clear()
+        self.cache["page_table"] = table
+
+    def _evict_slot(self, slot: int) -> None:
+        """Preempt one slot for its pages: rebase the request onto its
+        committed tokens and put it at the FRONT of the queue, so it
+        re-admits (re-prefilling prompt + committed, emitting nothing
+        twice) as soon as the pool breathes."""
+        request = self.slots[slot]
+        if request is None:
+            return
+        self._rebase(request)
+        request.slot = -1
+        request.prefill_pos = 0
+        self._pending_first.pop(slot, None)
+        self._prefilling = [s for s in self._prefilling if s != slot]
+        self._free_slot(slot)
+        self.pending.insert(0, request)
+        self.evictions += 1
+
+    def _rebase(self, request: Request) -> None:
+        """Fold the request's committed tokens into its prompt so a
+        fresh admission resumes generation where it left off.  The sum
+        always fits: ``prompt + committed`` IS the host finish test's
+        total, and a request at ``max_seq`` has already finished."""
+        request.prompt_tokens = list(request.base_prompt) \
+            + [int(token) for token in request.committed]
+        request.rebased = len(request.committed)
+
+    def recover(self) -> int:
+        """Rebuild device state after a device-level failure (an XLA
+        raise mid-block, a chaos ``decode_block`` kill): drop every
+        in-flight block and chained carry, reset the cache and page
+        pool, and re-queue each live request to resume from its LAST
+        EMITTED token -- prompt + committed re-prefill and generation
+        continues under the remaining budget; nothing already delivered
+        is re-emitted.  Returns how many requests were revived."""
+        revived = []
+        for slot in range(self.max_slots):
+            request, self.slots[slot] = self.slots[slot], None
+            if request is None or request.done:
+                continue
+            self._rebase(request)
+            request.slot = -1
+            request.prefill_pos = 0
+            revived.append(request)
+        self.pending = revived + self.pending
+        self._prefilling.clear()
+        self._pending_first.clear()
+        self._inflight.clear()
+        self._loop_inflight.clear()
+        self._chain = None
+        self._loop_chain = None
+        self._active_dev = None
+        self._temps_dev = None
+        self._force_inactive.clear()
+        self.lengths[:] = 0
+        self._lengths_upper[:] = 0
+        self.current[:] = 0
+        self.temperatures[:] = 0.0
+        self.decoding[:] = False
+        if self._pages is not None:
+            self._pages.reset()
+            self.cache = init_paged_cache(
+                self.config, self.max_slots, self.max_seq,
+                self.kv_page_tokens, self._pages.total)
+        else:
+            self.cache = llama.init_cache(self.config, self.max_slots,
+                                          self.max_seq)
+        if self._cache_put is not None:
+            self.cache = self._cache_put(self.cache)
+        self.recoveries += 1
+        return len(revived)
+
+    def take_request_stats(self) -> list[dict]:
+        """Drain per-request latency stamps ({"ttft_ms", "tpot_ms",
+        "tokens"}) recorded at finish -- the serving element feeds them
+        to the telemetry plane."""
+        stats, self._request_stats = self._request_stats, []
+        return stats
+
     def _emit(self, request: Request, token: int):
         request.generated += 1
         self.tokens_emitted += 1
+        now = time.perf_counter()
+        if request.generated == 1:
+            request.first_time = now
+        request.committed.append(token)
         # Cache position of the token currently being generated is
         # len(prompt) + generated - 1; the last usable write position is
         # max_seq - 2 (max_seq - 1 is the trash row), so finish once the
-        # sequence would need to write past it.
-        total_len = len(request.prompt_tokens) + request.generated
+        # sequence would need to write past it.  ``rebased`` backs out
+        # tokens recover()/eviction folded into the prompt, so a
+        # resumed request keeps the original arithmetic.
+        total_len = len(request.prompt_tokens) + request.generated \
+            - request.rebased
         finished = (token in request.eos_tokens
                     or request.generated >= request.max_new_tokens
                     or total_len >= self.max_seq)
@@ -451,18 +948,34 @@ class ContinuousBatcher:
             request.emit(request.request_id, token, finished)
         if finished:
             request.done = True
+            if request.submit_time:
+                ttft_ms = (request.first_time - request.submit_time) \
+                    * 1000.0
+                tpot_ms = (now - request.first_time) * 1000.0 \
+                    / (request.generated - 1) \
+                    if request.generated > 1 else 0.0
+                self._request_stats.append(
+                    {"ttft_ms": round(ttft_ms, 3),
+                     "tpot_ms": round(tpot_ms, 3),
+                     "tokens": request.generated})
             self._free_slot(request.slot)
 
     def _free_slot(self, slot: int):
-        """Release a slot's host-side state (finish and cancel paths
-        share this -- any new per-slot bookkeeping belongs here)."""
+        """Release a slot's host-side state (finish, cancel and
+        eviction share this -- any new per-slot bookkeeping belongs
+        here)."""
         self.slots[slot] = None
         self.lengths[slot] = 0
+        self._lengths_upper[slot] = 0
         self.current[slot] = 0
         self.temperatures[slot] = 0.0
         self._temps_dev = None
         self.decoding[slot] = False
         self._active_dev = None
+        if self.device_loop:
+            self._force_inactive.add(slot)
+        if self._pages is not None:
+            self._pages.release(slot)
 
     def cancel(self, request_id: str) -> bool:
         """Abandon a request by id: pending requests leave the queue; an
@@ -501,14 +1014,14 @@ class ContinuousBatcher:
 
     @property
     def blocks_in_flight(self) -> int:
-        """Dispatched-but-unretired fused decode blocks (pipelined
-        path); drive step() until this reaches 0 to drain them."""
-        return len(self._inflight)
+        """Dispatched-but-unretired fused/loop decode blocks; drive
+        step() until this reaches 0 to drain them."""
+        return len(self._inflight) + len(self._loop_inflight)
 
     def run_until_drained(self, max_steps: int = 100_000) -> int:
         steps = 0
-        while (self.pending or self.active_count or self._inflight) \
-                and steps < max_steps:
+        while (self.pending or self.active_count or self._inflight
+               or self._loop_inflight) and steps < max_steps:
             self.step()
             steps += 1
         return steps

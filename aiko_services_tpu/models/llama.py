@@ -22,13 +22,15 @@ import jax.numpy as jnp
 from ..ops.layers import (rms_norm, rope_frequencies, apply_rope,
                           attention_prefill, attention_decode_append)
 from ..parallel.mesh import P
+from .paged import (gather_layer, gather_slot, is_paged, paged_extent,
+                    pool_page_tokens, scatter_pages)
 from .quant import dequantize_kv, is_quantized, quantize_kv
 
 __all__ = ["LlamaConfig", "init_params", "partition_specs",
-           "cache_specs", "init_cache", "cache_array", "prefill",
-           "prefill_with_aux", "prefill_into_slot",
+           "cache_specs", "init_cache", "cache_array", "cache_extent",
+           "prefill", "prefill_with_aux", "prefill_into_slot",
            "prefill_into_slots", "decode_step", "decode_block",
-           "greedy_sample", "select_tokens"]
+           "decode_loop", "greedy_sample", "select_tokens"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -314,10 +316,22 @@ def _grouped(layer, kv: int):
 
 
 def cache_array(cache: dict):
-    """The cache's key payload array (shape introspection that works
-    for bf16 and int8 caches alike)."""
+    """The cache's key payload array (shape/sharding introspection that
+    works for bf16, int8 and paged caches alike -- for a paged cache
+    this is the PHYSICAL pool, so use :func:`cache_extent` for the
+    logical per-slot extent)."""
     k = cache["k"]
     return k["int8"] if is_quantized(k) else k
+
+
+def cache_extent(cache: dict) -> int:
+    """Logical per-slot token extent T of a serving cache: the T axis
+    of a dense cache, ``pages_per_slot * page_tokens`` of a paged one.
+    Position T-1 is the trash position either way (the paged trash
+    page sits behind the table's default entry 0)."""
+    if is_paged(cache):
+        return paged_extent(cache)
+    return cache_array(cache).shape[2]
 
 
 def matmul(x, w):
@@ -485,6 +499,11 @@ def _finish(params: dict, config: LlamaConfig, hidden) -> jax.Array:
 def _prefill_core(params: dict, config: LlamaConfig, tokens: jax.Array,
                   cache: dict, start_positions: jax.Array):
     """Shared prefill body -> (logits, cache, moe aux)."""
+    if is_paged(cache):
+        raise ValueError(
+            "prefill works on dense caches (training / whole-batch "
+            "path); paged serving admission goes through "
+            "prefill_into_slot(s)")
     c = config
     b, s = tokens.shape
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
@@ -554,30 +573,57 @@ def prefill_into_slot(params: dict, config: LlamaConfig,
     Queries attend the slot's whole cache row, so chunk N sees chunks
     0..N-1 written by earlier calls.  Returns (logits [1, S, vocab],
     cache) with the cache donated for in-place update.
+
+    A PAGED cache (models/paged.py) is written through its page table:
+    the chunk start must be page-aligned and S a whole number of pages
+    (the ContinuousBatcher's chunk discipline guarantees both), so the
+    write is one dynamic_update_slice per covered page and the
+    attention row is the slot's gathered page view.
     """
     c = config
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     s = tokens.shape[1]
     positions = start[None, None] + jnp.arange(s)[None, :]   # [1, S]
+    paged = is_paged(cache)
+    if paged:
+        table, page_tokens = cache["page_table"], pool_page_tokens(cache)
+        if s % page_tokens:
+            raise ValueError(
+                f"paged prefill chunk of {s} tokens is not a whole "
+                f"number of {page_tokens}-token pages")
 
     def factory(k_layer, v_layer):
         def kv_write(q, k, v):
             q = apply_rope(q, rope_table, positions)
             k = apply_rope(k, rope_table, positions)
 
-            def write(old, new):
-                return jax.lax.dynamic_update_slice(
-                    old, new, (slot, start) + (0,) * (old.ndim - 2))
+            if paged:
+                def write(old, new):
+                    return scatter_pages(old, new, table, [slot],
+                                         [start], page_tokens)
 
-            def row(arr):
-                return jax.lax.dynamic_slice(
-                    arr, (slot,) + (0,) * (arr.ndim - 1),
-                    (1,) + arr.shape[1:])
+                def row(arr):
+                    raise NotImplementedError   # paged uses gather_slot
+            else:
+                def write(old, new):
+                    return jax.lax.dynamic_update_slice(
+                        old, new, (slot, start) + (0,) * (old.ndim - 2))
+
+                def row(arr):
+                    return jax.lax.dynamic_slice(
+                        arr, (slot,) + (0,) * (arr.ndim - 1),
+                        (1,) + arr.shape[1:])
             k_layer2 = _kv_store(k_layer, k, write)
             v_layer2 = _kv_store(v_layer, v, write)
             kv_write.updated = (k_layer2, v_layer2)
-            k_row = _grouped(_kv_rows(k_layer2, row), c.n_kv_heads)
-            v_row = _grouped(_kv_rows(v_layer2, row), c.n_kv_heads)
+            if paged:
+                k_row = _grouped(gather_slot(k_layer2, table[slot]),
+                                 c.n_kv_heads)
+                v_row = _grouped(gather_slot(v_layer2, table[slot]),
+                                 c.n_kv_heads)
+            else:
+                k_row = _grouped(_kv_rows(k_layer2, row), c.n_kv_heads)
+                v_row = _grouped(_kv_rows(v_layer2, row), c.n_kv_heads)
             if c.attention == "flash":
                 # Causality from the traced chunk offset covers both
                 # intra-chunk masking and the unwritten cache tail.
@@ -592,9 +638,11 @@ def prefill_into_slot(params: dict, config: LlamaConfig,
             return attention_prefill(q, k_row, v_row, positions)
         return kv_write
 
-    logits, cache, _ = _forward_layers(
+    logits, new_cache, _ = _forward_layers(
         params, c, params["embed"][tokens], cache, factory)
-    return logits, cache
+    if paged:
+        new_cache["page_table"] = table
+    return logits, new_cache
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -622,21 +670,34 @@ def prefill_into_slots(params: dict, config: LlamaConfig,
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     n, s = tokens.shape
     positions = starts[:, None] + jnp.arange(s)[None, :]     # [N, S]
+    paged = is_paged(cache)
+    if paged:
+        table, page_tokens = cache["page_table"], pool_page_tokens(cache)
+        if s % page_tokens:
+            raise ValueError(
+                f"paged prefill chunk of {s} tokens is not a whole "
+                f"number of {page_tokens}-token pages")
 
     def factory(k_layer, v_layer):
         def kv_write(q, k, v):
             q = apply_rope(q, rope_table, positions)
             k = apply_rope(k, rope_table, positions)
 
-            def write_rows(old, new):
-                # Unrolled per-row DUS (in-place under donation; a
-                # batched scatter would copy the cache -- see
-                # decode_step).
-                for i in range(n):
-                    old = jax.lax.dynamic_update_slice(
-                        old, new[i:i + 1],
-                        (slots[i], starts[i]) + (0,) * (old.ndim - 2))
-                return old
+            if paged:
+                def write_rows(old, new):
+                    return scatter_pages(old, new, table, slots,
+                                         starts, page_tokens)
+            else:
+                def write_rows(old, new):
+                    # Unrolled per-row DUS (in-place under donation; a
+                    # batched scatter would copy the cache -- see
+                    # decode_step).
+                    for i in range(n):
+                        old = jax.lax.dynamic_update_slice(
+                            old, new[i:i + 1],
+                            (slots[i], starts[i])
+                            + (0,) * (old.ndim - 2))
+                    return old
 
             def gather_rows(arr):
                 return jnp.concatenate(
@@ -647,14 +708,24 @@ def prefill_into_slots(params: dict, config: LlamaConfig,
             k_l = _kv_store(k_layer, k, write_rows)
             v_l = _kv_store(v_layer, v, write_rows)
             kv_write.updated = (k_l, v_l)
-            k_rows = _grouped(_kv_rows(k_l, gather_rows), c.n_kv_heads)
-            v_rows = _grouped(_kv_rows(v_l, gather_rows), c.n_kv_heads)
+            if paged:
+                k_rows = _grouped(gather_layer(k_l, table[slots]),
+                                  c.n_kv_heads)
+                v_rows = _grouped(gather_layer(v_l, table[slots]),
+                                  c.n_kv_heads)
+            else:
+                k_rows = _grouped(_kv_rows(k_l, gather_rows),
+                                  c.n_kv_heads)
+                v_rows = _grouped(_kv_rows(v_l, gather_rows),
+                                  c.n_kv_heads)
             return attention_prefill(q, k_rows, v_rows, positions)
         return kv_write
 
-    logits, cache, _ = _forward_layers(
+    logits, new_cache, _ = _forward_layers(
         params, c, params["embed"][tokens], cache, factory)
-    return logits, cache
+    if paged:
+        new_cache["page_table"] = table
+    return logits, new_cache
 
 
 def _cache_distributed(cache) -> bool:
@@ -679,7 +750,16 @@ def _resolve_decode_flash(c: LlamaConfig, cache: dict) -> bool:
     """Pick the decode attention path EAGERLY (outside jit), where the
     cache's sharding is visible.  'auto' silently keeps dense for a
     distributed cache; explicit 'flash' raises rather than compiling a
-    per-layer all-gather of the whole cache."""
+    per-layer all-gather of the whole cache.  A PAGED cache is
+    dense-only: the Pallas kernel indexes the flat stacked cache in
+    its BlockSpecs, and there is no paged-attention kernel (yet)."""
+    if is_paged(cache):
+        if c.decode_attention == "flash":
+            raise ValueError(
+                "decode_attention='flash' cannot serve a paged KV "
+                "cache (the kernel's BlockSpecs index the flat dense "
+                "cache); use 'dense' or 'auto' with kv_page_tokens")
+        return False
     if c.decode_attention == "flash":
         if _cache_distributed(cache):
             raise ValueError(
@@ -689,11 +769,51 @@ def _resolve_decode_flash(c: LlamaConfig, cache: dict) -> bool:
                 "full every layer).  Use 'dense' -- or 'auto', which "
                 "falls back -- when serving with a sharded cache.")
         return True
-    cache_extent = cache_array(cache).shape[2]
+    extent = cache_array(cache).shape[2]
     return (c.decode_attention == "auto"
-            and cache_extent >= c.flash_decode_threshold
-            and cache_extent % 128 == 0
+            and extent >= c.flash_decode_threshold
+            and extent % 128 == 0
             and not _cache_distributed(cache))
+
+
+def _scatter_positions(config: LlamaConfig, cache: dict, k_tokens,
+                       v_tokens, positions) -> dict:
+    """Scatter per-token KV updates (``[L, B, S, K, hd]``) into the
+    cache at ``positions`` [B, S] -- the post-scan write shared by
+    decode_step (S=1) and the speculative verify chunk (S=k+1).  One
+    unrolled dynamic_update_slice per (row, position): in place under
+    donation for dense caches, and routed through the page table for
+    paged ones.  Returns the cache dict (page table values untouched:
+    paging changes WHERE bytes land, never the table itself)."""
+    b, s = positions.shape
+    paged = is_paged(cache)
+    if paged:
+        table = cache["page_table"]
+        page_tokens = pool_page_tokens(cache)
+
+    def scatter(layer, toks):
+        def write(old, new):                     # new [L, B, S, *]
+            for row in range(b):
+                for col in range(s):
+                    part = jax.lax.dynamic_slice(
+                        new, (0, row, col) + (0,) * (new.ndim - 3),
+                        (new.shape[0], 1, 1) + new.shape[3:])
+                    pos = positions[row, col]
+                    if paged:
+                        start = (0, table[row, pos // page_tokens],
+                                 pos % page_tokens)
+                    else:
+                        start = (0, row, pos)
+                    old = jax.lax.dynamic_update_slice(
+                        old, part, start + (0,) * (old.ndim - 3))
+            return old
+        return _kv_store(layer, toks, write)
+
+    out = {"k": scatter(cache["k"], k_tokens),
+           "v": scatter(cache["v"], v_tokens)}
+    if paged:
+        out["page_table"] = table
+    return out
 
 
 def _decode_step_impl(params: dict, config: LlamaConfig,
@@ -710,17 +830,18 @@ def _decode_step_impl(params: dict, config: LlamaConfig,
     b = tokens.shape[0]
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     positions = lengths[:, None]                       # [B, 1]
-    cache_extent = cache_array(cache).shape[2]
+    paged = is_paged(cache)
+    extent = cache_extent(cache)
     if use_flash is None:
         # In-jit callers (decode_block's scan, bench loops) have no
         # sharding to inspect; resolve on extent alone, as before.  The
         # stacked kernel needs a block-aligned cache extent (it never
         # pads -- padding a stacked cache would copy it); "auto" quietly
         # keeps dense for exotic extents, explicit "flash" raises there.
-        use_flash = c.decode_attention == "flash" or (
+        use_flash = not paged and (c.decode_attention == "flash" or (
             c.decode_attention == "auto"
-            and cache_extent >= c.flash_decode_threshold
-            and cache_extent % 128 == 0)
+            and extent >= c.flash_decode_threshold
+            and extent % 128 == 0))
 
     def scatter_tokens(updates):
         # One dynamic_update_slice per batch row, unrolled.  A single
@@ -729,19 +850,12 @@ def _decode_step_impl(params: dict, config: LlamaConfig,
         # in full by the layer scan, and the scatter makes XLA copy the
         # whole cache every step (~1.25 ms at llama3-1b/1k on v5e); the
         # unrolled DUS chain updates in place.  b is a static trace-time
-        # constant (the slot count), so the unroll is bounded.
+        # constant (the slot count), so the unroll is bounded.  Paged
+        # caches route each row's write through its page table.
         k_tokens, v_tokens = updates               # [L, B, 1, K, hd]
-
-        def scatter(layer, tokens):
-            def write(old, new):
-                for row in range(b):
-                    old = jax.lax.dynamic_update_slice(
-                        old, new[:, row][:, None],
-                        (0, row, lengths[row]) + (0,) * (old.ndim - 3))
-                return old
-            return _kv_store(layer, tokens, write)
-        return {"k": scatter(cache["k"], k_tokens),
-                "v": scatter(cache["v"], v_tokens)}
+        new_cache = _scatter_positions(c, cache, k_tokens, v_tokens,
+                                       lengths[:, None])
+        return new_cache
 
     if use_flash:
         # Split-K Pallas kernel path (ops/pallas_decode.py): the cache
@@ -786,11 +900,18 @@ def _decode_step_impl(params: dict, config: LlamaConfig,
             k = apply_rope(k, rope_table, positions)
             # The cache stays a read-only scan input; only the token's
             # k/v leave the scan (see _forward_layers / the post-scan
-            # scatter above).
+            # scatter above).  A paged layer is gathered to the same
+            # logical [B, T, ...] view first (the gather-reshape feeds
+            # the attention einsums directly).
             kv_write.updated = (k, v)
+            if paged:
+                k_view = gather_layer(k_layer, cache["page_table"])
+                v_view = gather_layer(v_layer, cache["page_table"])
+            else:
+                k_view, v_view = k_layer, v_layer
             return attention_decode_append(
-                q, _grouped(k_layer, c.n_kv_heads),
-                _grouped(v_layer, c.n_kv_heads), k, v, lengths)
+                q, _grouped(k_view, c.n_kv_heads),
+                _grouped(v_view, c.n_kv_heads), k, v, lengths)
         return kv_write
 
     logits, new_cache, _ = _forward_layers(
@@ -865,7 +986,7 @@ def _decode_block_jit(params: dict, config: LlamaConfig, tokens: jax.Array,
     position so a speculative block dispatched near the cache boundary
     can never scatter out of bounds.
     """
-    trash = cache_array(cache).shape[2] - 1
+    trash = cache_extent(cache) - 1
 
     def body(carry, _):
         tokens, cache, lengths, key = carry
@@ -899,3 +1020,283 @@ def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
 
 
 decode_block.__wrapped__ = _decode_block_jit.__wrapped__
+
+
+# ---------------------------------------------------------------------------
+# Device-resident generation loop (ISSUE 8 tentpole): a lax.while_loop
+# that samples, detects stops and (optionally) speculates entirely
+# on-device, so the host fetches a BLOCK of emitted tokens at a time
+# instead of driving one round trip per token.
+
+
+def _ngram_draft(history, tokens, k: int):
+    """Self-drafting proposal from the recent-token window: find the
+    most recent PRIOR occurrence of the current token in ``history``
+    (the newest entry IS the current token) and propose the ``k``
+    tokens that followed it; rows with no prior occurrence repeat the
+    current token.  Unfilled window entries are -1 (never a real
+    token id) and fall back to repetition too.
+
+    history: [B, W] (old -> new); tokens: [B].  Returns [B, k] int32.
+    """
+    w = history.shape[1]
+    prior = history[:, :-1]                          # continuation exists
+    match = prior == tokens[:, None]
+    latest = jnp.where(match, jnp.arange(w - 1)[None, :], -1).max(1)
+    gather = jnp.clip(latest[:, None] + 1 + jnp.arange(k)[None, :],
+                      0, w - 1)
+    continuation = jnp.take_along_axis(history, gather, axis=1)
+    drafts = jnp.where((latest >= 0)[:, None] & (continuation >= 0),
+                       continuation, tokens[:, None])
+    return drafts.astype(jnp.int32)
+
+
+def _history_push(history, candidates, cut):
+    """Append each row's first ``cut[b]`` candidate tokens to its
+    recent-token window, dropping the oldest: one per-row gather over
+    ``concat(history, candidates)`` shifted by ``cut`` -- rejected
+    candidates (beyond the cut) sit past the gather's reach, so they
+    never enter the window."""
+    w = history.shape[1]
+    combined = jnp.concatenate([history, candidates.astype(history.dtype)],
+                               axis=1)
+    index = jnp.arange(w)[None, :] + cut[:, None]
+    return jnp.take_along_axis(combined, index, axis=1)
+
+
+def _chunk_verify(params, config: LlamaConfig, chunk, cache, starts,
+                  trash: int):
+    """One batched multi-token target step: forward ``chunk`` [B, S]
+    (current token + S-1 draft tokens per row) at per-row positions
+    ``starts + i``, writing every position's KV optimistically and
+    returning logits for all S positions.  The cache stays a read-only
+    scan input (chunk KV is concatenated onto the attention's key axis
+    with explicit key positions) and the S writes scatter once after
+    the scan -- the decode_step discipline, not the full-cache rewrite
+    prefill pays.  Rejected drafts leave garbage KV beyond the
+    advanced length, which the length masks never admit and later
+    decode overwrites before exposing -- the same overshoot contract
+    the fused block path established.  Positions clamp to the trash
+    position at the cache boundary (rows there stop this iteration,
+    and their clamped-position tokens are cut before emission)."""
+    c = config
+    b, s = chunk.shape
+    rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    positions = jnp.minimum(starts[:, None] + jnp.arange(s)[None, :],
+                            trash)                           # [B, S]
+    paged = is_paged(cache)
+    extent = cache_extent(cache)
+
+    def factory(k_layer, v_layer):
+        def kv_write(q, k, v):
+            q = apply_rope(q, rope_table, positions)
+            k = apply_rope(k, rope_table, positions)
+            kv_write.updated = (k, v)
+            if paged:
+                k_view = gather_layer(k_layer, cache["page_table"])
+                v_view = gather_layer(v_layer, cache["page_table"])
+            else:
+                k_view, v_view = k_layer, v_layer
+            k_rows = _grouped(k_view, c.n_kv_heads)
+            v_rows = _grouped(v_view, c.n_kv_heads)
+            if is_quantized(k_rows):
+                # The verify chunk is compute-shaped (S queries), so
+                # dequantizing the gathered rows -- the flash
+                # admission path's trick -- beats teaching the
+                # concat-attention the int8 split.
+                k_rows = dequantize_kv(k_rows, q.dtype)
+                v_rows = dequantize_kv(v_rows, q.dtype)
+            k_all = jnp.concatenate([k_rows, k], axis=1)
+            v_all = jnp.concatenate([v_rows, v], axis=1)
+            kv_positions = jnp.concatenate(
+                [jnp.broadcast_to(jnp.arange(extent)[None, :],
+                                  (b, extent)), positions], axis=1)
+            valid = jnp.concatenate(
+                [jnp.arange(extent)[None, :] < starts[:, None],
+                 jnp.ones((b, s), dtype=bool)], axis=1)
+            return attention_prefill(q, k_all, v_all, positions,
+                                     kv_length_mask=valid,
+                                     kv_positions=kv_positions)
+        return kv_write
+
+    def scatter_chunk(updates):
+        k_tokens, v_tokens = updates             # [L, B, S, K, hd]
+        return _scatter_positions(c, cache, k_tokens, v_tokens,
+                                  positions)
+
+    logits, new_cache, _ = _forward_layers(
+        params, c, params["embed"][chunk], cache, factory,
+        cache_from_updates=scatter_chunk)
+    return logits, new_cache
+
+
+@partial(jax.jit,
+         static_argnames=("config", "ring", "speculative", "spec_tokens",
+                          "use_flash"),
+         donate_argnames=("cache",))
+def _decode_loop_jit(params: dict, draft: dict, config: LlamaConfig,
+                     tokens: jax.Array, cache: dict, lengths: jax.Array,
+                     active: jax.Array, budget: jax.Array,
+                     temperatures: jax.Array, eos: jax.Array,
+                     history: jax.Array, key: jax.Array, *, ring: int,
+                     speculative: str, spec_tokens: int,
+                     use_flash: bool):
+    """The device-resident serving loop: up to ``ring`` tokens per row
+    generated inside ONE dispatch, with sampling, per-slot stop
+    detection (EOS + budget + cache boundary) and speculative
+    multi-token decoding all in the ``lax.while_loop`` carry.  The
+    host's only per-block work is one counted fetch of the emitted
+    ring; every carry comes back as a device array so block k+1 chains
+    off block k without a round trip.
+
+    tokens: [B] current (sampled, unprocessed) tokens; lengths: [B]
+    valid cache positions (prompt + generated); active: [B] bool;
+    budget: [B] tokens each row may still emit; eos: [B, E] per-row
+    stop tokens (-1 pads); history: [B, W] recent-token window for the
+    n-gram draft ([B, 1] dummy otherwise).  The loop exits when every
+    row stopped, or when the ring cannot hold another iteration's
+    worst-case emission (speculation emits up to spec_tokens+1 per row
+    per iteration).
+
+    Returns ``(emitted [B, ring], counts [B], tokens', lengths',
+    active', budget', history', key', accepted [B], drafted [B],
+    steps, cache)`` -- ``accepted``/``drafted`` count this block's
+    draft tokens proposed and kept (the speculation acceptance
+    telemetry), ``steps`` the target-model iterations the block ran.
+    """
+    b = tokens.shape[0]
+    trash = cache_extent(cache) - 1
+    extent = cache_extent(cache)
+    spec = speculative != "off"
+    k = spec_tokens if spec else 0
+    per_iter = k + 1
+
+    def stops(token, budget_left, total):
+        """Stop verdict AFTER emitting ``token`` with ``budget_left``
+        remaining and ``total`` cache length -- mirrors the host
+        batcher's finish test exactly (the equivalence contract)."""
+        return ((token[:, None] == eos).any(-1) | (budget_left <= 0)
+                | (total >= extent))
+
+    def cond(carry):
+        (i, tokens, cache, lengths, active, budget, key, emitted,
+         counts, history, accepted, drafted) = carry
+        room = jnp.where(active, counts, 0).max() + per_iter <= ring
+        return (i < ring) & active.any() & room
+
+    def body_plain(carry):
+        (i, tokens, cache, lengths, active, budget, key, emitted,
+         counts, history, accepted, drafted) = carry
+        positions = jnp.where(active, jnp.minimum(lengths, trash), trash)
+        logits, cache = _decode_step_impl(params, config, tokens, cache,
+                                          positions, use_flash=use_flash)
+        key, sub = jax.random.split(key)
+        sampled = select_tokens(sub, logits, temperatures).astype(
+            jnp.int32)
+        slot_index = jnp.where(active, counts, ring)     # ring = trash col
+        emitted = emitted.at[jnp.arange(b), slot_index].set(sampled)
+        counts = counts + active
+        lengths = lengths + active
+        budget = budget - active
+        stop = stops(sampled, budget, lengths) & active
+        tokens = jnp.where(active, sampled, tokens)
+        return (i + 1, tokens, cache, lengths, active & ~stop, budget,
+                key, emitted, counts, history, accepted, drafted)
+
+    def body_spec(carry):
+        (i, tokens, cache, lengths, active, budget, key, emitted,
+         counts, history, accepted, drafted) = carry
+        greedy_row = active & (temperatures <= 0)
+        if speculative == "ngram":
+            drafts = _ngram_draft(history, tokens, k)        # [B, k]
+        else:
+            # Self-drafting from the quantized tree: k cheap decode
+            # steps whose KV writes the verify pass overwrites with
+            # target-weight KV at the same positions.
+            def draft_step(carry2, step):
+                current, cache2 = carry2
+                pos = jnp.where(active,
+                                jnp.minimum(lengths + step, trash), trash)
+                logits2, cache2 = _decode_step_impl(
+                    draft, config, current, cache2, pos,
+                    use_flash=use_flash)
+                current = jnp.argmax(logits2, -1).astype(jnp.int32)
+                return (current, cache2), current
+            (_, cache), drafts = jax.lax.scan(
+                draft_step, (tokens, cache),
+                jnp.arange(k, dtype=jnp.int32))
+            drafts = drafts.T                                # [B, k]
+        chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        starts = jnp.where(active, jnp.minimum(lengths, trash), trash)
+        logits, cache = _chunk_verify(params, config, chunk, cache,
+                                      starts, trash)
+        key, sub = jax.random.split(key)
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)    # [B, k+1]
+        first = select_tokens(sub, logits[:, 0, :],
+                              temperatures).astype(jnp.int32)
+        candidates = greedy.at[:, 0].set(first)
+        # Longest matching draft prefix; sampled rows accept none (the
+        # per-token distribution stays exactly the non-speculative one).
+        match = (chunk[:, 1:] == candidates[:, :-1]) & greedy_row[:, None]
+        accept = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(1)
+        offsets = jnp.arange(per_iter)[None, :]              # [1, k+1]
+        budget_after = budget[:, None] - (offsets + 1)
+        total_after = lengths[:, None] + offsets + 1
+        stop_at = ((candidates[:, :, None] == eos[:, None, :]).any(-1)
+                   | (budget_after <= 0) | (total_after >= extent))
+        clean_before = jnp.cumsum(
+            jnp.pad(stop_at[:, :-1], ((0, 0), (1, 0))), axis=1) == 0
+        emit_at = ((offsets <= accept[:, None]) & clean_before
+                   & active[:, None])
+        cut = emit_at.sum(1)                                 # [B]
+        slot_index = jnp.where(emit_at, counts[:, None] + offsets, ring)
+        emitted = emitted.at[jnp.arange(b)[:, None],
+                             slot_index].set(candidates)
+        counts = counts + cut
+        lengths = lengths + cut
+        budget = budget - cut
+        stopped = (emit_at & stop_at).any(1)
+        last = jnp.take_along_axis(
+            candidates, jnp.maximum(cut - 1, 0)[:, None], axis=1)[:, 0]
+        tokens = jnp.where(active & (cut > 0), last, tokens)
+        accepted = accepted + jnp.where(active, jnp.maximum(cut - 1, 0),
+                                        0)
+        drafted = drafted + jnp.where(greedy_row, k, 0)
+        if speculative == "ngram":
+            history = _history_push(history, candidates, cut)
+        return (i + 1, tokens, cache, lengths, active & ~stopped,
+                budget, key, emitted, counts, history, accepted, drafted)
+
+    carry = (jnp.int32(0), tokens, cache, lengths, active, budget, key,
+             jnp.zeros((b, ring + 1), dtype=jnp.int32),
+             jnp.zeros((b,), dtype=jnp.int32), history,
+             jnp.zeros((b,), dtype=jnp.int32),
+             jnp.zeros((b,), dtype=jnp.int32))
+    (steps, tokens, cache, lengths, active, budget, key, emitted,
+     counts, history, accepted, drafted) = jax.lax.while_loop(
+        cond, body_spec if spec else body_plain, carry)
+    return (emitted[:, :ring], counts, tokens, lengths, active, budget,
+            history, key, accepted, drafted, steps, cache)
+
+
+def decode_loop(params: dict, config: LlamaConfig, tokens: jax.Array,
+                cache: dict, lengths: jax.Array, active: jax.Array,
+                budget: jax.Array, temperatures: jax.Array,
+                eos: jax.Array, history: jax.Array, key: jax.Array, *,
+                ring: int, speculative: str = "off",
+                spec_tokens: int = 4, draft: dict | None = None):
+    """Device-resident generation block (see _decode_loop_jit); the
+    flash-vs-dense choice resolves here on the concrete cache's
+    sharding/structure, exactly as in :func:`decode_step`."""
+    if speculative not in ("off", "ngram", "draft"):
+        raise ValueError(
+            f"speculative={speculative!r}: one of off|ngram|draft")
+    return _decode_loop_jit(params, draft if draft is not None else params,
+                            config, tokens, cache, lengths, active,
+                            budget, temperatures, eos, history, key,
+                            ring=int(ring), speculative=speculative,
+                            spec_tokens=int(spec_tokens),
+                            use_flash=_resolve_decode_flash(config, cache))
+
+
+decode_loop.__wrapped__ = _decode_loop_jit.__wrapped__

@@ -1,0 +1,239 @@
+"""Paged KV cache for continuous-batching LLM serving (ISSUE 8).
+
+The monolithic serving cache (``llama.init_cache``) allocates
+``[slots, max_seq]`` up front: every slot pays worst-case sequence
+memory whether it holds a 12-token chat turn or an 8k document, and the
+slot extent is welded into the compiled decode step.  This module stores
+KV in fixed-size **pages** instead:
+
+- one physical **pool** per cache side, ``[L, P, page_tokens, K*hd]``
+  (int8 caches pair it with a ``[L, P, page_tokens, K, 1]`` scale pool
+  -- the per-token-per-head scales ride their page);
+- a device **page table** ``[B, pages_per_slot] int32`` mapping each
+  slot's logical pages to physical pages.  Entry 0 is the reserved
+  TRASH page: unallocated logical pages point at it, and inactive
+  batch rows route their decode writes there (the paged twin of the
+  dense path's ``max_seq - 1`` trash position);
+- a host-side :class:`PageAllocator` (free list + per-slot
+  assignments).  Admission takes pages as prompts actually need them,
+  decode grows a slot page-at-a-time, and eviction returns the slot's
+  pages to the pool -- ragged lengths stop forcing worst-case
+  allocation, and admit/evict never changes a compiled shape (the pool
+  and table shapes are static; only table *values* change).
+
+Device access goes through gather/scatter:
+``llama.prefill_into_slot(s)`` / ``decode_step`` / ``decode_loop``
+detect a paged cache (:func:`is_paged`) and (a) gather a slot's pages
+into the contiguous row view their attention already consumes, (b)
+scatter KV writes through the table with per-position
+``dynamic_update_slice`` (in-place under donation, same discipline as
+the dense path).  The gather materializes the logical view, so paged
+decode streams the cache roughly twice per step on TPU -- the price of
+paging without a paged-attention kernel; the win is memory (pool sized
+to the *live* token count) and recompile-free admission.  Pallas
+flash-decode indexes the flat stacked cache directly and is therefore
+dense-only; paged configs keep dense attention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .quant import is_quantized
+
+__all__ = ["PageAllocator", "init_paged_cache", "is_paged",
+           "pages_per_slot", "pool_page_tokens", "paged_extent",
+           "gather_layer", "gather_slot", "scatter_pages"]
+
+
+def pages_per_slot(max_seq: int, page_tokens: int) -> int:
+    if page_tokens <= 0 or max_seq % page_tokens:
+        raise ValueError(
+            f"kv_page_tokens={page_tokens}: must divide max_seq "
+            f"({max_seq})")
+    return max_seq // page_tokens
+
+
+def init_paged_cache(config, batch: int, max_seq: int | None = None,
+                     page_tokens: int = 64,
+                     total_pages: int | None = None) -> dict:
+    """Paged serving cache: ``{"k": pool, "v": pool, "page_table"}``.
+
+    ``total_pages`` counts PHYSICAL pages including the reserved trash
+    page 0 (default: full provisioning, ``batch * pages_per_slot + 1``
+    -- memory parity with the dense cache; size it down to serve more
+    slots than worst-case memory allows, with the ContinuousBatcher
+    preempting under pool pressure)."""
+    c = config
+    t = max_seq or c.max_seq
+    pps = pages_per_slot(t, page_tokens)
+    pool_pages = batch * pps + 1 if total_pages is None \
+        else int(total_pages)
+    if pool_pages < pps + 1:
+        raise ValueError(
+            f"kv_pages={pool_pages}: the pool must hold at least one "
+            f"full slot plus the trash page ({pps + 1})")
+    shape = (c.n_layers, pool_pages, page_tokens,
+             c.n_kv_heads * c.head_dim)
+    if c.kv_dtype == "int8":
+        def side():
+            return {"int8": jnp.zeros(shape, dtype=jnp.int8),
+                    "scale": jnp.zeros(
+                        shape[:-1] + (c.n_kv_heads, 1),
+                        dtype=jnp.float32)}
+    else:
+        def side():
+            return jnp.zeros(shape, dtype=jnp.dtype(c.dtype))
+    return {"k": side(), "v": side(),
+            "page_table": jnp.zeros((batch, pps), dtype=jnp.int32)}
+
+
+def is_paged(cache) -> bool:
+    return isinstance(cache, dict) and "page_table" in cache
+
+
+def _payload(layer):
+    return layer["int8"] if is_quantized(layer) else layer
+
+
+def pool_page_tokens(cache: dict) -> int:
+    """Static tokens-per-page of a paged cache's pool."""
+    return _payload(cache["k"]).shape[2]
+
+
+def paged_extent(cache: dict) -> int:
+    """Logical per-slot extent (== max_seq) of a paged cache."""
+    return cache["page_table"].shape[1] * pool_page_tokens(cache)
+
+
+def _gather(arr, table):
+    """``[P, pt, ...]`` pool -> logical rows via an index-array gather:
+    table [B, pps] -> [B, pps*pt, ...]; table [pps] -> [pps*pt, ...].
+    Contiguous-minor reshape after the gather, so the result matches
+    the dense cache's flat row layout exactly."""
+    rows = arr[table]
+    lead = table.shape[:-1]
+    return rows.reshape(*lead, -1, *arr.shape[2:])
+
+
+def gather_layer(layer, table):
+    """One pool layer (payload or int8 dict) -> the dense flat layer
+    view ``[B, T, ...]`` the attention consumers expect."""
+    if is_quantized(layer):
+        return {"int8": _gather(layer["int8"], table),
+                "scale": _gather(layer["scale"], table)}
+    return _gather(layer, table)
+
+
+def scatter_pages(old, new, table, slots, starts, page_tokens: int):
+    """Write whole-page prefill rows through the page table: one
+    ``dynamic_update_slice`` per (row, covered page).  ``old`` is one
+    pool side ``[P, pt, ...]``, ``new`` the page-aligned chunk
+    ``[N, S, ...]`` (S a whole number of pages), ``slots``/``starts``
+    index ``new``'s rows into the table (scalars may be traced; the
+    row/page unroll is static).  Duplicated bucket-pad rows rewrite the
+    same physical pages with the same values.  The single shared
+    authority for both prefill paths (models/llama.py)."""
+    n, s = new.shape[0], new.shape[1]
+    for i in range(n):
+        for j in range(s // page_tokens):
+            page = table[slots[i], starts[i] // page_tokens + j]
+            part = jax.lax.dynamic_slice(
+                new, (i, j * page_tokens) + (0,) * (new.ndim - 2),
+                (1, page_tokens) + new.shape[2:])
+            old = jax.lax.dynamic_update_slice(
+                old, part, (page, 0) + (0,) * (old.ndim - 2))
+    return old
+
+
+def gather_slot(layer, table_row):
+    """One slot's pages -> its contiguous ``[1, T, ...]`` row view."""
+    if is_quantized(layer):
+        return {"int8": _gather(layer["int8"], table_row)[None],
+                "scale": _gather(layer["scale"], table_row)[None]}
+    return _gather(layer, table_row)[None]
+
+
+class PageAllocator:
+    """Host-side free list + per-slot page assignments.  Owned by the
+    ContinuousBatcher (single-threaded with its step loop); the device
+    page table is updated from :attr:`dirty` rows folded into the next
+    dispatch, so allocation never costs a device round trip of its
+    own."""
+
+    def __init__(self, total_pages: int, pages_per_slot: int,
+                 max_slots: int):
+        self.total = int(total_pages)
+        self.pps = int(pages_per_slot)
+        self.max_slots = int(max_slots)
+        # Page 0 is the reserved trash page; ascending hand-out order
+        # keeps tests deterministic.
+        self._free = list(range(self.total - 1, 0, -1))
+        self._slots: dict[int, dict[int, int]] = {}
+        # slot -> host table row pending upload (numpy-friendly lists).
+        self.dirty: dict[int, list[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, tokens: int, page_tokens: int) -> int:
+        return min(self.pps,
+                   -(-max(0, int(tokens)) // int(page_tokens)))
+
+    def holds(self, slot: int) -> int:
+        return len(self._slots.get(slot, ()))
+
+    def missing(self, slot: int, pages: int) -> int:
+        """How many NEW pages covering logical pages [0, pages) would
+        need allocating for ``slot``."""
+        owned = self._slots.get(slot, {})
+        return sum(1 for logical in range(min(pages, self.pps))
+                   if logical not in owned)
+
+    def ensure(self, slot: int, pages: int) -> bool:
+        """Allocate (atomically) whatever logical pages [0, pages) the
+        slot is missing.  False (and no change) when the free list
+        cannot cover them."""
+        pages = min(int(pages), self.pps)
+        owned = self._slots.setdefault(slot, {})
+        wanted = [logical for logical in range(pages)
+                  if logical not in owned]
+        if len(wanted) > len(self._free):
+            return False
+        if wanted:
+            row = self.dirty.setdefault(slot, self._row(slot))
+            for logical in wanted:
+                phys = self._free.pop()
+                owned[logical] = phys
+                row[logical] = phys
+        return True
+
+    def release(self, slot: int) -> int:
+        """Return every page the slot holds to the pool (slot finish,
+        cancel, eviction) and mark its table row for reset."""
+        owned = self._slots.pop(slot, {})
+        if not owned:
+            return 0
+        self._free.extend(sorted(owned.values(), reverse=True))
+        self.dirty[slot] = [0] * self.pps
+        return len(owned)
+
+    def reset(self) -> None:
+        """Forget everything (device state was rebuilt)."""
+        self._free = list(range(self.total - 1, 0, -1))
+        self._slots.clear()
+        self.dirty.clear()
+
+    def _row(self, slot: int) -> list[int]:
+        row = [0] * self.pps
+        for logical, phys in self._slots.get(slot, {}).items():
+            row[logical] = phys
+        return row
+
+    @property
+    def stats(self) -> dict:
+        return {"total": self.total, "free": self.free_pages,
+                "held": {slot: len(pages)
+                         for slot, pages in self._slots.items()}}

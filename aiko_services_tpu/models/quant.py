@@ -35,7 +35,8 @@ import jax.numpy as jnp
 from ..parallel.mesh import P
 
 __all__ = ["quantize_weight", "quantize_params", "quantize_specs",
-           "quantize_kv", "dequantize_kv", "is_quantized"]
+           "quantize_kv", "dequantize_kv", "is_quantized",
+           "draft_params"]
 
 # The layer-stacked matmul weights + the unembed projection; embeddings
 # (gather, not matmul) and norm vectors stay bf16.
@@ -99,6 +100,20 @@ def quantize_params(params: dict) -> dict:
     quantized["layers"] = layers
     quantized["unembed"] = quantize_weight(params["unembed"])
     return quantized
+
+
+def draft_params(params: dict) -> dict:
+    """The self-drafting tree for ``speculative: draft`` serving
+    (models/llama.py decode_loop): the draft model IS the target's
+    weight-only-int8 quantization, so drafting streams half the weight
+    bytes per step and needs no second checkpoint.  An already
+    quantized target tree is returned AS-IS (the draft then agrees
+    with the target step-for-step at temperature 0 and acceptance is
+    ~1); a bf16 tree gets one quantization pass at batcher build, not
+    per dispatch."""
+    if is_quantized(params.get("unembed")):
+        return params
+    return quantize_params(params)
 
 
 def quantize_specs(specs: dict) -> dict:

@@ -283,6 +283,14 @@ class PipelineTelemetry:
                      "p50_ms": summary["p50_ms"],
                      "p90_ms": summary["p90_ms"],
                      "p99_ms": summary["p99_ms"]}
+            if name in ("llm_ttft_ms", "llm_tpot_ms"):
+                # LLM serving latency (ISSUE 8): per-request time to
+                # first token and per-output-token rate, fed by the
+                # serving element's batcher; rides share as
+                # telemetry.llm.* next to the llm_accepted_tokens /
+                # llm_draft_tokens counters below.
+                result.setdefault("llm", {})[name[4:]] = brief
+                continue
             if name == "frame_latency_ms":
                 result["frame"] = brief
             elif name == "element_latency_ms":

@@ -93,7 +93,8 @@ def _split_kv(layer):
 
 def attention_prefill(q: jax.Array, k: jax.Array, v: jax.Array,
                       q_positions: jax.Array,
-                      kv_length_mask: jax.Array | None = None) -> jax.Array:
+                      kv_length_mask: jax.Array | None = None,
+                      kv_positions: jax.Array | None = None) -> jax.Array:
     """Causal attention for a prompt chunk.
 
     q: [B, S, H, hd]; k/v: [B, T, K, hd] where K divides H -- grouped
@@ -102,8 +103,12 @@ def attention_prefill(q: jax.Array, k: jax.Array, v: jax.Array,
     (at llama3-1b decode that materialization alone is ~4x the whole
     cache's HBM traffic per step); q_positions: [B, S] absolute
     positions of the queries (so chunked prefill against a longer cache
-    works); kv_length_mask: [B, T] bool of valid cache slots.  float32
-    softmax.
+    works); kv_length_mask: [B, T] bool of valid cache slots;
+    kv_positions: [B, T] absolute positions of the keys -- defaults to
+    ``arange(T)`` (keys ARE the cache row); the speculative verify
+    step passes an explicit vector because its key axis concatenates
+    the cache row with the draft chunk's per-row offset positions
+    (models/llama.py decode_loop).  float32 softmax.
 
     k/v may be int8-quantized cache layers (``{"int8", "scale"}``,
     models/quant.py:quantize_kv): key scales multiply the score logits,
@@ -124,8 +129,11 @@ def attention_prefill(q: jax.Array, k: jax.Array, v: jax.Array,
     if k_scale is not None:                        # [B,T,K] -> [B,K,1,1,T]
         logits = logits * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     t = k.shape[1]
-    kv_positions = jnp.arange(t)[None, None, None, None, :]  # [1,1,1,1,T]
-    causal = kv_positions <= \
+    if kv_positions is None:
+        key_pos = jnp.arange(t)[None, None, None, None, :]  # [1,1,1,1,T]
+    else:
+        key_pos = kv_positions[:, None, None, None, :]      # [B,1,1,1,T]
+    causal = key_pos <= \
         q_positions[:, None, None, :, None]        # [B,1,1,S,T]
     if kv_length_mask is not None:
         causal = jnp.logical_and(

@@ -106,6 +106,11 @@ class TransferLedger:
         self.policy = policy
         self.implicit = 0
         self.explicit = 0
+        # Labeled sub-counts of ``explicit`` (e.g. the LLM element's
+        # per-decode-block fetch, label "llm_block"): lets tests and
+        # the bench assert a path pays EXACTLY one fetch per unit of
+        # work, not merely "some" fetches.
+        self.explicit_by_label: dict = {}
         # Counters are bumped from the event loop AND stage-worker
         # threads (pipeline/stages.py): unsynchronized += would lose
         # increments.
@@ -135,17 +140,22 @@ class TransferLedger:
         message = str(error).lower()
         return "transfer" in message and "disallow" in message
 
-    def fetch(self, tree):
+    def fetch(self, tree, label: str | None = None):
         """ONE explicit host fetch of every device leaf in ``tree``
         (non-array leaves pass through untouched -- strings/lists/dicts
         in a swag must not become numpy).  Counted once per call, not
-        per leaf; runs under an ``allow`` scope so the engine's own
-        sinks never trip the guard they enforce."""
+        per leaf -- under ``label`` too when given (the device-loop
+        serving contract: one "llm_block" fetch per retired block);
+        runs under an ``allow`` scope so the engine's own sinks never
+        trip the guard they enforce."""
         leaves = device_leaves(tree)
         if not leaves:
             return tree
         with self._count_lock:
             self.explicit += 1
+            if label:
+                self.explicit_by_label[label] = \
+                    self.explicit_by_label.get(label, 0) + 1
         with jax.transfer_guard_device_to_host("allow"):
             for leaf in leaves:
                 if hasattr(leaf, "copy_to_host_async"):
@@ -173,7 +183,8 @@ class TransferLedger:
     @property
     def stats(self) -> dict:
         return {"policy": self.policy, "implicit": self.implicit,
-                "explicit": self.explicit}
+                "explicit": self.explicit,
+                "explicit_by_label": dict(self.explicit_by_label)}
 
 
 class DeviceWindow:

@@ -1005,3 +1005,91 @@ def test_replica_scoped_dispatch_probe_spares_healthy_peers(runtime):
     fired = pipeline.fault_stats()["plan"]["fired"]
     assert fired == {"element_raise": 1, "device_kill": 1}
     pipeline.stop()
+
+
+def test_decode_block_kill_replays_generation_from_last_block(runtime):
+    """ISSUE 8 satellite: a ``decode_block`` device_kill firing
+    MID-GENERATION (after the first loop block retired, so tokens are
+    already committed) replays every live request from its last
+    emitted block -- the frame completes with text IDENTICAL to an
+    unfaulted run (nothing lost, nothing re-emitted), one recovery."""
+    def llm_pipeline(name, fault_rules):
+        parameters = {}
+        if fault_rules:
+            parameters["fault_plan"] = {"rules": fault_rules}
+        return Pipeline(
+            {"version": 0, "name": name, "runtime": "jax",
+             "parameters": parameters,
+             "graph": ["(llm)"],
+             "elements": [{
+                 "name": "llm",
+                 "input": [{"name": "text"}],
+                 "output": [{"name": "text"}],
+                 # inflight 1: each step dispatches one block (one
+                 # probe) and retires it, so ``after: 1`` fires with
+                 # block 1's tokens already emitted.
+                 "parameters": {"max_new_tokens": 12, "max_seq": 64,
+                                "decode_block_tokens": 4, "inflight": 1},
+                 "deploy": {"local": {
+                     "module": "aiko_services_tpu.elements.llm",
+                     "class_name": "LLM"}}}]},
+            runtime=runtime)
+
+    def generate(pipeline):
+        responses: queue.Queue = queue.Queue()
+        stream = pipeline.create_stream_local(
+            "s", queue_response=responses)
+        pipeline.create_frame_local(stream, {"text": "chaos prompt"})
+        assert run_until(runtime, lambda: not responses.empty(),
+                         timeout=120.0)
+        _, _, swag, _, okay, diagnostic = responses.get()
+        assert okay, diagnostic
+        return swag["text"]
+
+    reference_pipe = llm_pipeline("llm_ref", None)
+    reference = generate(reference_pipe)
+    reference_pipe.stop()
+
+    pipeline = llm_pipeline("llm_chaos", [
+        {"point": "decode_block", "target": "llm", "after": 1,
+         "count": 1}])
+    text = generate(pipeline)
+    assert text == reference, "replayed generation diverged"
+    batcher = pipeline.graph.get_node("llm").element._batcher
+    assert batcher.recoveries == 1
+    assert pipeline.fault_stats()["plan"]["fired"] == {"decode_block": 1}
+    pipeline.stop()
+
+
+def test_decode_block_hang_delays_but_completes(runtime):
+    """A ``decode_block`` rule WITH delay_ms hangs one dispatch; the
+    generation still completes (no recovery fired -- a hang is not a
+    death)."""
+    pipeline = Pipeline(
+        {"version": 0, "name": "llm_hang", "runtime": "jax",
+         "parameters": {"fault_plan": {"rules": [
+             {"point": "decode_block", "target": "llm", "count": 1,
+              "delay_ms": 150}]}},
+         "graph": ["(llm)"],
+         "elements": [{
+             "name": "llm",
+             "input": [{"name": "text"}],
+             "output": [{"name": "text"}],
+             "parameters": {"max_new_tokens": 6, "max_seq": 64,
+                            "decode_block_tokens": 4},
+             "deploy": {"local": {
+                 "module": "aiko_services_tpu.elements.llm",
+                 "class_name": "LLM"}}}]},
+        runtime=runtime)
+    responses: queue.Queue = queue.Queue()
+    stream = pipeline.create_stream_local("s", queue_response=responses)
+    pipeline.create_frame_local(stream, {"text": "hang on"})
+    assert run_until(runtime, lambda: not responses.empty(),
+                     timeout=120.0)
+    _, _, swag, _, okay, diagnostic = responses.get()
+    assert okay, diagnostic
+    assert isinstance(swag["text"], str)
+    batcher = pipeline.graph.get_node("llm").element._batcher
+    assert batcher.recoveries == 0
+    assert pipeline.fault_stats()["plan"]["fired"] == {"decode_block": 1}
+    pipeline.stop()

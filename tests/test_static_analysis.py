@@ -34,6 +34,7 @@ DEFINITION_FIXTURES = {
     "replicas_on_unplaced.json": "replicas-on-unplaced",
     "placement_remote.json": "placement-remote",
     "bad_parameter.json": "bad-parameter",
+    "bad_element_parameter.json": "bad-parameter",
     "bad_source.py": "bad-source",
     "undeclared_host_input.json": "undeclared-host-input",
     "device_fn_host_call.json": "device-fn-host-call",
@@ -67,6 +68,23 @@ def test_selfcheck_fixture_fires_exactly_its_rule(dirname, rule):
                                  registry={})
     assert [f.rule for f in findings] == [rule], \
         "\n".join(f.render() for f in findings)
+
+
+def test_element_parameter_domains_scoped_to_module():
+    """ELEMENT_PARAMETERS is keyed by (module, class): a user's
+    unrelated class that happens to be named LLM never has the serving
+    element's value domains imposed on it, while path-form references
+    to the real module normalize and match."""
+    from aiko_services_tpu.analysis.params import \
+        validate_element_parameters
+
+    assert validate_element_parameters(
+        "LLM", {"speculative": "banana"}, "p: a",
+        module="my_app.models") == []
+    findings = validate_element_parameters(
+        "LLM", {"speculative": "banana"}, "p: a",
+        module="aiko_services_tpu/elements/llm.py")
+    assert [f.rule for f in findings] == ["bad-parameter"]
 
 
 def test_every_rule_has_a_fixture():
