@@ -114,6 +114,22 @@ def analyze_dataflow(definition) -> list:
                 f"{source}: {element.name}",
                 module=deploy.get("module", ""))
             if disables.active("bad-parameter", element.name))
+    # Binary data plane (ISSUE 9): forcing the tensor pipe on a
+    # pipeline whose every element is local binds a socket no frame
+    # will ever cross -- almost always a leftover from splitting a
+    # definition, not intent (``auto`` negotiates per peer and is the
+    # right default everywhere).
+    if str(definition.parameters.get("data_plane", "")).strip().lower() \
+            == "tensor_pipe" \
+            and not any(element.deploy_remote is not None
+                        for element in definition.elements):
+        add("data-plane-on-local",
+            "data_plane: tensor_pipe, but no element is "
+            "remote-deployed -- no frame ever leaves this process, so "
+            "the pipe endpoint serves nothing (use 'auto', which "
+            "negotiates per peer)",
+            f"{source}.parameters.data_plane")
+
     # Placement validity itself comes from the ONE shared authority
     # (definition.placement_error), which _build_placement also raises
     # from -- the rule here only adds the lint packaging.

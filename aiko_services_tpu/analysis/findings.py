@@ -84,7 +84,12 @@ RULES: dict[str, tuple[str, str]] = {
     "bad-parameter": (ERROR,
                       "pipeline parameter value outside its domain "
                       "(unknown enum choice, negative count/deadline, "
-                      "unparseable fault plan)"),
+                      "unparseable fault plan or mesh spec)"),
+    "data-plane-on-local": (WARNING,
+                            "data_plane: tensor_pipe forced on a "
+                            "pipeline with no remote stages -- the "
+                            "pipe binds a socket no frame will ever "
+                            "cross"),
     # -- residency & fusion (element AST) ------------------------------
     "bad-source": (ERROR,
                    "source file (element module or definition) is "

@@ -110,7 +110,57 @@ PIPELINE_PARAMETERS: dict[str, ParamSpec] = {
     "fault_plan": ParamSpec(
         "chaos FaultPlan armed at startup (rules list / JSON)",
         kind="json"),
+    # -- binary data plane + multi-host mesh (ISSUE 9) -----------------
+    "data_plane": ParamSpec(
+        "remote-stage tensor path: auto (pipe when the peer "
+        "advertises one), tensor_pipe, or mqtt (control-fabric "
+        "payloads only)",
+        choices=("auto", "tensor_pipe", "mqtt")),
+    "tensor_pipe_host": ParamSpec(
+        "interface the tensor-pipe endpoint binds (default "
+        "127.0.0.1; use a routable address for real multi-host)"),
+    "tensor_pipe_port": ParamSpec(
+        "tensor-pipe listen port (0 = kernel-assigned)",
+        number=True, minimum=0),
+    "pipe_claim_timeout_ms": ParamSpec(
+        "how long an envelope waits for its pipe tensors before the "
+        "frame is dropped like a wire drop",
+        number=True, minimum=0),
+    "pipe_token_capacity": ParamSpec(
+        "endpoint token-store cap; must exceed in-flight forwards or "
+        "evicted frames pay the claim timeout (counted)",
+        number=True, minimum=1),
+    "mesh": ParamSpec(
+        "multi-host mesh mode: {hosts: N, coordinator, process_id} "
+        "(dict or JSON; AIKO_MESH_* env equivalent)",
+        kind="json"),
 }
+
+
+def mesh_spec_error(value) -> str | None:
+    """Why a ``mesh`` parameter value is malformed, or None -- the
+    jax-free twin of ``pipeline.tensor.distributed_mesh_spec``'s
+    validation, so pre-flight and runtime can never disagree."""
+    import json as _json
+    if isinstance(value, str):
+        try:
+            value = _json.loads(value)
+        except _json.JSONDecodeError as error:
+            return f"unparseable JSON ({error})"
+    if not isinstance(value, dict) or "hosts" not in value:
+        return f"expected {{'hosts': N, ...}}, got {value!r}"
+    try:
+        hosts = int(value["hosts"])
+    except (TypeError, ValueError):
+        return f"hosts={value['hosts']!r} is not an integer"
+    if hosts < 1:
+        return f"hosts must be >= 1, got {hosts}"
+    try:
+        int(value.get("process_id") or 0)
+    except (TypeError, ValueError):
+        return f"process_id={value.get('process_id')!r} is not an " \
+               f"integer"
+    return None
 
 
 #: (module, class) -> {parameter: spec}: the serving knobs with real
@@ -190,6 +240,12 @@ def _check_value(name: str, spec: ParamSpec, value, spot: str) \
             FaultPlan.parse(value)
         except (ValueError, TypeError) as error:
             return Finding("bad-parameter", f"fault_plan: {error}", spot)
+    if spec.kind == "json" and name == "mesh" and value is not None:
+        # ``is not None``, not truthiness: {} and "" are malformed
+        # specs the runtime rejects, so pre-flight must too.
+        problem = mesh_spec_error(value)
+        if problem is not None:
+            return Finding("bad-parameter", f"mesh: {problem}", spot)
     return None
 
 

@@ -387,6 +387,23 @@ class PipelineTelemetry:
                     registry.gauge("replica_occupancy",
                                    round(group.occupancy(index), 4),
                                    **labels)
+        # Binary data plane (ISSUE 9): path split, negotiated
+        # fallbacks and endpoint drops -- the scrape-side proof that
+        # remote tensors ride the pipe (and that drops are never
+        # silent, the satellite contract on tensor_pipe's queue).
+        plane = getattr(pipeline, "data_plane_stats", None)
+        if callable(plane):
+            try:
+                stats = plane()
+            except Exception:
+                stats = {}
+            if stats:
+                registry.gauge("data_plane_frames",
+                               stats.get("pipe_frames", 0))
+                registry.gauge("data_plane_fallbacks",
+                               stats.get("fallbacks", 0))
+                registry.gauge("tensor_pipe_dropped_frames",
+                               stats.get("dropped_frames", 0))
         registry.gauge("traces_buffered", len(self.traces))
         registry.gauge("traces_completed", self.traces.completed)
         return registry.render_text()

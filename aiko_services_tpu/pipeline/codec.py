@@ -27,7 +27,7 @@ import io
 import numpy as np
 
 __all__ = ["encode_value", "decode_value", "encode_frame_data",
-           "decode_frame_data"]
+           "decode_frame_data", "tag_view", "untag_view"]
 
 _NPY_PREFIX = "npy64:"
 # Extension-dtype arrays (ml_dtypes: bfloat16, float8_*...):
@@ -93,6 +93,29 @@ def decode_value(value):
     if isinstance(value, dict):
         return {k: decode_value(v) for k, v in value.items()}
     return value
+
+
+def tag_view(array: np.ndarray) -> tuple[np.ndarray, str | None]:
+    """(wire array, dtype tag): extension dtypes (bfloat16, float8_*)
+    cross binary transports as same-itemsize integer VIEWS plus a name
+    tag -- the exact tagging the ``npyt:`` string path above uses, so
+    the tensor-pipe data plane and the MQTT codec can never disagree on
+    how bf16 round-trips.  Plain dtypes pass through untagged."""
+    array = np.asarray(array)
+    if _extension_dtype(array.dtype):
+        return array.view(_VIEW_BY_ITEMSIZE[array.dtype.itemsize]), \
+            array.dtype.name
+    return array, None
+
+
+def untag_view(array: np.ndarray, tag: str | None) -> np.ndarray:
+    """Restore a :func:`tag_view` integer view to its tagged dtype."""
+    if not tag:
+        return array
+    import ml_dtypes
+    if not hasattr(ml_dtypes, tag):
+        raise ValueError(f"codec: unknown extension dtype {tag!r}")
+    return array.view(np.dtype(getattr(ml_dtypes, tag)))
 
 
 def encode_frame_data(frame_data: dict) -> dict:

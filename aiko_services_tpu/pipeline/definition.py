@@ -166,6 +166,15 @@ def placement_error(block: dict) -> str | None:
         problem = _replicas_error(block["replicas"])
         if problem:
             return problem
+    if "host" in block:
+        # Mesh mode (ISSUE 9): pins the stage to one host group of a
+        # ``mesh: {hosts: N}`` pipeline; range-checked at carve time
+        # (the group count depends on the live mesh).
+        host = block["host"]
+        if not isinstance(host, int) or isinstance(host, bool) \
+                or host < 0:
+            return (f"placement host must be a non-negative host "
+                    f"index, got {host!r}")
     if "mesh" in block:
         mesh = block["mesh"]
         if not isinstance(mesh, dict) or not mesh or not all(

@@ -28,6 +28,9 @@ import time
 from typing import Any
 
 from .codec import decode_frame_data, encode_frame_data
+from .data_plane import (DATA_PLANE_MODES, PIPE_CLAIM_TIMEOUT_MS_DEFAULT,
+                         PIPE_TAG, PIPE_TOKEN_CAPACITY_DEFAULT,
+                         PipeSender, TensorPipeEndpoint, split_arrays)
 from .definition import (PipelineDefinition, parse_pipeline_definition,
                          load_pipeline_definition, DefinitionError,
                          placement_error)
@@ -49,7 +52,8 @@ from ..analysis.lint import preflight as preflight_check
 from ..faults import (CircuitBreaker, FaultInjected, FaultPlan,
                       wire_fault_filter)
 from ..runtime import Lease
-from ..services import Actor, ServiceFilter, get_service_proxy, do_discovery
+from ..services import (Actor, ServiceFilter, ServiceTags,
+                        get_service_proxy, do_discovery)
 from ..services.service import SERVICE_PROTOCOL_PREFIX
 from ..utils import (Graph, GraphError, get_logger, generate, load_module,
                      parse_number, process_memory_rss)
@@ -112,6 +116,11 @@ class RemoteStage(PipelineElement):
         super().__init__(context)
         self.service_filter = service_filter
         self.remote_topic_path: str | None = None
+        # Data-plane negotiation (ISSUE 9): the peer's advertised
+        # tensor-pipe endpoint ("host:port") from its registrar-record
+        # ``tensor_pipe=`` tag; None = the peer speaks MQTT only and
+        # forwards ride the control fabric (counted, never silent).
+        self.remote_pipe: str | None = None
         self._discovery = None
 
     def start_discovery(self):
@@ -121,12 +130,15 @@ class RemoteStage(PipelineElement):
 
     def _on_found(self, record, proxy):
         self.remote_topic_path = record.topic_path
-        self.logger.info("remote stage %s found: %s",
-                         self.name, record.topic_path)
+        self.remote_pipe = ServiceTags.get(record.tags, PIPE_TAG)
+        self.logger.info("remote stage %s found: %s (data plane: %s)",
+                         self.name, record.topic_path,
+                         self.remote_pipe or "mqtt")
 
     def _on_lost(self, record, proxy):
         if record.topic_path == self.remote_topic_path:
             self.remote_topic_path = None
+            self.remote_pipe = None
             self.logger.warning("remote stage %s lost", self.name)
 
     def process_frame(self, stream, **inputs):
@@ -150,6 +162,56 @@ class Pipeline(Actor):
         # The keyword (``pipeline create --check`` -> "strict") beats
         # the definition's ``preflight`` parameter.
         preflight_report = preflight_check(definition, mode=preflight)
+        # Binary data plane (ISSUE 9): unless ``data_plane: mqtt``, the
+        # pipeline binds a per-process tensor-pipe endpoint BEFORE the
+        # actor registers, so the registrar record advertises it as a
+        # ``tensor_pipe=host:port`` tag alongside the MQTT topic.
+        # Remote-stage frames then ship tensors over the pipe (raw
+        # bytes, zero base64) while the control envelope stays on MQTT;
+        # peers advertising no pipe negotiate down to the MQTT payload
+        # path (counted).  A bind failure degrades the same way --
+        # frames are never lost to the data plane being unavailable.
+        mode = str(definition.parameters.get(
+            "data_plane", "auto")).strip().lower()
+        if mode not in DATA_PLANE_MODES:
+            _logger.warning("data_plane=%r not one of %s; using auto",
+                            mode, DATA_PLANE_MODES)
+            mode = "auto"
+        self._data_plane_mode = mode
+        self._data_endpoint: TensorPipeEndpoint | None = None
+        if mode != "mqtt":
+            try:
+                self._data_endpoint = TensorPipeEndpoint(
+                    host=str(definition.parameters.get(
+                        "tensor_pipe_host", "127.0.0.1")),
+                    port=int(parse_number(
+                        definition.parameters.get("tensor_pipe_port"),
+                        0)),
+                    claim_timeout_s=float(parse_number(
+                        definition.parameters.get(
+                            "pipe_claim_timeout_ms"),
+                        PIPE_CLAIM_TIMEOUT_MS_DEFAULT)) / 1000.0,
+                    capacity=int(parse_number(
+                        definition.parameters.get(
+                            "pipe_token_capacity"),
+                        PIPE_TOKEN_CAPACITY_DEFAULT)))
+            except Exception as error:
+                _logger.warning("tensor-pipe data plane unavailable "
+                                "(%s); frames ride MQTT", error)
+        tags = list(tags or [])
+        if self._data_endpoint is not None:
+            tags.append(f"{PIPE_TAG}={self._data_endpoint.location}")
+        self._pipe_senders: dict[str, PipeSender] = {}
+        self._pipe_token_seq = 0
+        self._pipe_fallback_logged: set = set()
+        # Per-stream ingest-order hold queue: a pipe frame whose
+        # tensors are still in TCP flight when its envelope lands must
+        # not let a LATER complete frame overtake it (see
+        # _claim_for_ingest).
+        self._pipe_ingest_wait: dict[str, list] = {}
+        self._plane_counts = {"pipe_frames": 0, "pipe_bytes": 0,
+                              "mqtt_frames": 0, "mqtt_bytes": 0,
+                              "fallbacks": 0, "claims_dropped": 0}
         super().__init__(name or definition.name, PROTOCOL_PIPELINE,
                          tags=tags, runtime=runtime)
         if preflight_report is not None:
@@ -186,6 +248,9 @@ class Pipeline(Actor):
         self._frames_processed = 0
         self._remote_retries = 0
         self.share["remote_stage_retries"] = 0
+        self.share["data_plane_frames"] = 0
+        self.share["data_plane_fallbacks"] = 0
+        self.share["tensor_pipe_dropped_frames"] = 0
         # Failure recovery (ISSUE 5): fault-injection plan (None =
         # unarmed, zero hot-path work), per-remote-stage circuit
         # breakers, lazily built fallback elements, and the recovery
@@ -268,8 +333,28 @@ class Pipeline(Actor):
         in the frame loop -- a pure ICI reshard, no host round-trip
         (the TPU analogue of the reference's remote-process deploy,
         reference pipeline.py:246-258)."""
+        from .tensor import distributed_mesh_spec, ensure_distributed
+
+        # Multi-host mesh mode (ISSUE 9): ``mesh: {hosts: N}`` (or the
+        # AIKO_MESH_* env) spans one logical pipeline across hosts --
+        # jax.distributed bring-up when a coordinator is configured,
+        # then per-host submesh carving so same-mesh stage hops ride
+        # ICI/DCN and only genuinely foreign processes pay the pipe.
+        try:
+            mesh_spec = distributed_mesh_spec(self.definition.parameters)
+        except ValueError as error:
+            raise DefinitionError(
+                f"pipeline {self.definition.name!r}: {error}")
+        if mesh_spec is not None:
+            try:
+                ensure_distributed(mesh_spec)
+            except Exception as error:
+                raise DefinitionError(
+                    f"pipeline {self.definition.name!r}: "
+                    f"jax.distributed bring-up failed: {error}")
         stages = {}
         replica_specs = {}
+        stage_hosts = {}
         for element_def in self.definition.elements:
             block = element_def.placement
             if not block:
@@ -297,6 +382,8 @@ class Pipeline(Actor):
                 continue
             if "replicas" in block:
                 replica_specs[element_def.name] = block["replicas"]
+            if "host" in block:
+                stage_hosts[element_def.name] = int(block["host"])
         if not stages:
             return None
         from .tensor import StagePlacement
@@ -310,8 +397,18 @@ class Pipeline(Actor):
             # Start at the floor; the control loop (and reassign after
             # recovery) grows toward the max as load demands.
             replicas[name] = low if low < high else high
-        placement.assign(stages, replicas=replicas or None,
-                         replica_min=replica_min or None)
+        try:
+            placement.assign(
+                stages, replicas=replicas or None,
+                replica_min=replica_min or None,
+                hosts=mesh_spec["hosts"] if mesh_spec else None,
+                stage_hosts=stage_hosts or None)
+        except ValueError as error:
+            if mesh_spec is None:
+                raise               # pre-existing over-request surface
+            raise DefinitionError(
+                f"pipeline {self.definition.name!r}: mesh placement: "
+                f"{error}")
         return placement
 
     @staticmethod
@@ -459,7 +556,10 @@ class Pipeline(Actor):
                             len(failed_devices))
         try:
             placement.replace(failed_devices)
-        except RuntimeError as error:
+        except (RuntimeError, ValueError) as error:
+            # ValueError: the mesh-mode hosted carve (a pinned stage's
+            # host group lost too many chips) -- terminal exactly like
+            # the pool running out, not an escape past the health path.
             self.logger.error("stage re-placement impossible: %s", error)
             self._cancel_health_timer()
             self.ec_producer.update("placement_failed", str(error))
@@ -963,6 +1063,253 @@ class Pipeline(Actor):
                                       for s in self.fused_segments),
                 "dispatches": sum(s.calls for s in self.fused_segments),
                 "broken": sum(1 for s in self.fused_segments if s.broken)}
+
+    # -- binary data plane (ISSUE 9) ---------------------------------------
+
+    def data_plane_stats(self) -> dict:
+        """The control/data-split accounting the bench and tests read:
+        frames/bytes per path, negotiated fallbacks, endpoint drops and
+        expired claims, per-peer sender state."""
+        stats = dict(self._plane_counts)
+        stats["mode"] = self._data_plane_mode
+        endpoint = self._data_endpoint
+        if endpoint is not None:
+            stats.update(endpoint.stats)
+            self.share["tensor_pipe_dropped_frames"] = endpoint.dropped
+        stats["senders"] = {location: sender.stats
+                            for location, sender
+                            in self._pipe_senders.items()}
+        return stats
+
+    def _pipe_sender(self, location: str) -> PipeSender:
+        sender = self._pipe_senders.get(location)
+        if sender is None:
+            sender = self._pipe_senders[location] = PipeSender(location)
+        return sender
+
+    def _next_pipe_token(self) -> str:
+        # Unique across processes: the service topic path is unique per
+        # (host, pid, service), the counter per forward attempt.
+        self._pipe_token_seq += 1
+        return f"{self.topic_path}#{self._pipe_token_seq}"
+
+    def _count_plane(self, pipe_bytes, envelope_len: int) -> None:
+        counts = self._plane_counts
+        if pipe_bytes is None:
+            counts["mqtt_frames"] += 1
+            counts["mqtt_bytes"] += int(envelope_len)
+        else:
+            counts["pipe_frames"] += 1
+            counts["pipe_bytes"] += int(pipe_bytes) + int(envelope_len)
+            self.share["data_plane_frames"] = counts["pipe_frames"]
+
+    def _count_pipe_fallback(self, where: str, reason: str) -> None:
+        """A frame whose tensors were pipe-eligible rode MQTT instead
+        (peer advertises no pipe, send failed, breaker open): counted
+        on the share dict and the telemetry plane, logged once per
+        (site, reason) so a degraded data plane is VISIBLE without
+        spamming every frame."""
+        self._plane_counts["fallbacks"] += 1
+        self.share["data_plane_fallbacks"] = \
+            self._plane_counts["fallbacks"]
+        # Exposition rides the metrics_text gauge refresh (like
+        # data_plane_frames) -- registering the same name as a counter
+        # TOO would emit duplicate samples and invalidate the scrape.
+        mark = (where, reason)
+        if mark not in self._pipe_fallback_logged:
+            self._pipe_fallback_logged.add(mark)
+            self.logger.warning("data plane: %s: %s -- tensors ride "
+                                "MQTT (counted, see "
+                                "data_plane_fallbacks)", where, reason)
+
+    def _pipe_ship(self, pipe_location, frame_data: dict, header: dict,
+                   where: str):
+        """Try to ship ``frame_data``'s arrays over the tensor pipe to
+        ``pipe_location``; on success the header grows the claim token
+        + key list and the returned body holds only the residue for
+        the MQTT envelope.  Any failure returns the FULL frame_data --
+        the MQTT path is the always-correct fallback, so a data-plane
+        problem costs bytes, never frames.  Returns (body, pipe_bytes
+        or None)."""
+        arrays = split_arrays(frame_data)
+        if not arrays:
+            return frame_data, None
+        if not pipe_location:
+            self._count_pipe_fallback(
+                where, "peer advertises no tensor pipe")
+            return frame_data, None
+        sender = self._pipe_sender(str(pipe_location))
+        token = self._next_pipe_token()
+        sent = sender.send(token, arrays)
+        if sent is None:
+            self._count_pipe_fallback(
+                where, f"pipe send to {pipe_location} failed or "
+                       f"breaker open")
+            return frame_data, None
+        header["pipe_token"] = token
+        header["pipe_keys"] = sorted(arrays)
+        body = {key: value for key, value in frame_data.items()
+                if key not in arrays}
+        return body, sent
+
+    def _count_claim_dropped(self, token, command: str) -> None:
+        self._plane_counts["claims_dropped"] += 1
+        self.logger.warning(
+            "data plane: %s token %s expired with tensors missing -- "
+            "dropping the envelope (sender recovers via deadline/"
+            "breaker, exactly as for a dropped wire frame)",
+            command, token)
+
+    def _claim_for_ingest(self, stream_dict: dict,
+                          frame_data: dict) -> dict | None:
+        """Pair an inbound ``process_frame`` envelope with its pipe
+        tensors.  Returns the claimed arrays ({} when the frame has no
+        pipe token) or None when the envelope was handled elsewhere --
+        deferred behind the endpoint watch, queued behind an earlier
+        still-waiting frame of the same stream (ingest order is a
+        per-stream contract: the pipe and the envelope race, and a
+        complete frame must not overtake an incomplete predecessor),
+        or dropped after the claim timeout."""
+        stream_key = str(stream_dict.get("stream_id",
+                                         DEFAULT_STREAM_ID))
+        waiting = self._pipe_ingest_wait.get(stream_key)
+        token = stream_dict.get("pipe_token")
+        if waiting is not None:
+            # An earlier frame of this stream is still waiting for its
+            # tensors: hold THIS envelope (tokened or not) behind it.
+            waiting.append((stream_dict, frame_data))
+            return None
+        if not token:
+            return {}
+        keys = [str(key) for key in
+                (stream_dict.get("pipe_keys") or [])]
+        endpoint = self._data_endpoint
+        if endpoint is None:
+            # The sender saw our advertised tag but the endpoint is
+            # gone (mode flipped live): the tensors are unreachable.
+            self._count_claim_dropped(token, "process_frame")
+            return None
+        claimed = endpoint.claim(token, keys)
+        if claimed is not None:
+            return claimed
+        if stream_dict.get("pipe_deferred"):
+            # Second pass (watch fired at the timeout, tensors still
+            # missing -- the pipe died with them in a kernel buffer).
+            # Tell the origin so it RE-FORWARDS this frame over MQTT:
+            # a data-plane loss must cost latency, never the frame.
+            self._count_claim_dropped(token, "process_frame")
+            response_topic = stream_dict.get("response_topic")
+            if response_topic:
+                header = {"stream_id": stream_dict.get(
+                              "stream_id", DEFAULT_STREAM_ID),
+                          "frame_id": stream_dict.get("frame_id"),
+                          "okay": False, "pipe_retry": True,
+                          "diagnostic": "tensor pipe payload missing "
+                                        "(claim timeout)"}
+                self.runtime.message.publish(
+                    response_topic,
+                    generate("process_frame_response", [header, {}]))
+            return None
+        stream_dict["pipe_deferred"] = True
+        self._pipe_ingest_wait[stream_key] = []
+        endpoint.watch(
+            token, keys,
+            lambda: self.post_self("ingest_pipe_ready",
+                                   [stream_key, stream_dict,
+                                    frame_data]))
+        return None
+
+    def ingest_pipe_ready(self, stream_key, stream_dict, frame_data):
+        """Continuation: the head waiting frame's pipe tensors arrived
+        (or its claim timed out).  Ingest it first, then replay the
+        envelopes held behind it in arrival order -- an entry that is
+        itself incomplete re-establishes the hold and the remainder
+        queues behind it again."""
+        held = self._pipe_ingest_wait.pop(str(stream_key), None) or []
+        self.process_frame(stream_dict, frame_data)
+        for held_dict, held_data in held:
+            self.process_frame(held_dict, held_data)
+
+    def _claim_pipe_response(self, stream_dict: dict,
+                             frame_data: dict) -> dict | None:
+        """The response twin of ``_claim_for_ingest``.  Responses need
+        no ordering hold: a parked frame resumes by id whenever ITS
+        response completes."""
+        token = stream_dict.get("pipe_token")
+        if not token:
+            return {}
+        keys = [str(key) for key in
+                (stream_dict.get("pipe_keys") or [])]
+        endpoint = self._data_endpoint
+        if endpoint is None:
+            self._count_claim_dropped(token, "process_frame_response")
+            return None
+        claimed = endpoint.claim(token, keys)
+        if claimed is not None:
+            return claimed
+        if stream_dict.get("pipe_deferred"):
+            # The RESPONSE's tensors died with the pipe: re-forward the
+            # still-parked frame over MQTT (the remote re-executes --
+            # the same idempotency the wire-retry paths already
+            # assume); past the retry bound, the deadline/breaker
+            # machinery recovers it like any dropped response.
+            self._count_claim_dropped(token, "process_frame_response")
+            self._retry_parked_over_mqtt(stream_dict)
+            return None
+        stream_dict["pipe_deferred"] = True
+        endpoint.watch(
+            token, keys,
+            lambda: self.post_self("process_frame_response",
+                                   [stream_dict, frame_data]))
+        return None
+
+    def _retry_parked_over_mqtt(self, stream_dict: dict) -> None:
+        """A pipe-shipped payload for a parked frame never arrived:
+        re-forward the frame over the MQTT payload path, once per
+        frame (``pipe_retries``) -- past that, the deadline/breaker
+        machinery owns recovery."""
+        stream = self.streams.get(str(stream_dict.get(
+            "stream_id", DEFAULT_STREAM_ID)))
+        frame = stream.frames.get(int(parse_number(
+            stream_dict.get("frame_id"), -1))) \
+            if stream is not None else None
+        if frame is None or frame.paused_pe_name is None \
+                or frame.paused_pe_name not in self.graph:
+            return
+        node = self.graph.get_node(frame.paused_pe_name)
+        if not isinstance(node.element, RemoteStage):
+            return
+        if frame.metrics.get("pipe_retries", 0) >= 1:
+            return
+        frame.metrics["pipe_retries"] = \
+            frame.metrics.get("pipe_retries", 0) + 1
+        self._count_pipe_fallback(
+            f"re-forward to {node.name}",
+            "pipe payload missing; resending over MQTT")
+        self._forward_frame(stream, frame, node, force_mqtt=True)
+
+    def _upload_claimed(self, stream_id, claimed: dict) -> dict:
+        """Claimed pipe tensors land host-side zero-copy; when the
+        stream's head is a PLACED stage, ``device_put`` them straight
+        onto its submesh here -- the upload overlaps the walk dispatch
+        instead of serializing at the first stage hop (which skips
+        leaves already resident)."""
+        placement = self.stage_placement
+        if placement is None:
+            return claimed
+        stream = self.streams.get(str(stream_id))
+        head = stream.graph_path if stream is not None \
+            and stream.graph_path else \
+            (self.graph.heads[0].name if self.graph.heads else None)
+        if head not in placement.plans:
+            return claimed
+        try:
+            return placement.transfer(claimed, head)
+        except Exception:
+            self.logger.exception("data plane: device_put of claimed "
+                                  "tensors onto stage %r failed; "
+                                  "leaving them host-side", head)
+            return claimed
 
     # -- fault harness + failure recovery (ISSUE 5) ------------------------
 
@@ -1639,9 +1986,21 @@ class Pipeline(Actor):
 
     def process_frame(self, stream_dict=None, frame_data=None):
         """Wire command: ``(process_frame (stream_id: X ...) (k: v ...))``.
-        Values arrive as strings/encoded blobs; decode and run."""
+        Values arrive as strings/encoded blobs; decode and run.  A
+        ``pipe_token`` header means the frame's tensors rode the
+        binary data plane: claim them from the endpoint (deferring the
+        envelope when they are still in TCP flight) and merge them in
+        -- zero base64, zero host copy beyond the socket read."""
         stream_dict = dict(stream_dict or {})
-        frame_data = decode_frame_data(dict(frame_data or {}))
+        frame_data = dict(frame_data or {})
+        claimed = self._claim_for_ingest(stream_dict, frame_data)
+        if claimed is None:
+            return              # deferred / held / dropped
+        frame_data = decode_frame_data(frame_data)
+        if claimed:
+            frame_data.update(self._upload_claimed(
+                stream_dict.get("stream_id", DEFAULT_STREAM_ID),
+                claimed))
         self._ingest(stream_dict, frame_data)
 
     def process_frame_local(self, frame_data: dict,
@@ -1693,6 +2052,9 @@ class Pipeline(Actor):
             frame_id = stream.next_frame_id()
         frame = Frame(frame_id=int(frame_id), swag=dict(frame_data))
         frame.response_topic = stream_dict.get("response_topic")
+        # The origin's tensor-pipe endpoint, when it advertises one:
+        # this process ships the response's tensors back over it.
+        frame.pipe_reply = stream_dict.get("pipe_reply")
         if self.telemetry is not None:
             # A forwarded frame carries its origin's trace context: the
             # spans stamped here join THAT trace (and ride back in the
@@ -2983,9 +3345,22 @@ class Pipeline(Actor):
                 # Forwarded frame: return this process's spans so the
                 # ORIGIN reconstructs the whole distributed trace.
                 header["spans"] = encode_spans(frame.spans)
+            # Response tensors ride the origin's pipe when it
+            # advertised one (pipe_reply header); failures re-inline
+            # them into the MQTT payload, counted.
+            # Site key is the PEER endpoint, not the stream id: the
+            # once-per-site fallback log (and its dedup set) must stay
+            # bounded under thousands of short streams.
+            body, pipe_bytes = (bare_swag, None) \
+                if self._data_plane_mode == "mqtt" or not okay \
+                else self._pipe_ship(frame.pipe_reply, bare_swag,
+                                     header,
+                                     f"response to "
+                                     f"{frame.pipe_reply or 'origin'}")
             payload = generate("process_frame_response",
-                               [header, encode_frame_data(bare_swag)])
+                               [header, encode_frame_data(body)])
             self.runtime.message.publish(frame.response_topic, payload)
+            self._count_plane(pipe_bytes, len(payload))
         if stream.queue_response is not None:
             # Snapshot: queue consumers read from other threads, and
             # the live dict must stay loop-confined (see Frame.metrics).
@@ -2996,7 +3371,8 @@ class Pipeline(Actor):
 
     # -- remote stage park / forward / resume ------------------------------
 
-    def _forward_frame(self, stream: Stream, frame: Frame, node) -> bool:
+    def _forward_frame(self, stream: Stream, frame: Frame, node,
+                       force_mqtt: bool = False) -> bool:
         stage: RemoteStage = node.element
         if stage.remote_topic_path is None:
             return False
@@ -3010,6 +3386,11 @@ class Pipeline(Actor):
         header = {"stream_id": stream.stream_id,
                   "frame_id": frame.frame_id,
                   "response_topic": self.topic_in}
+        if self._data_endpoint is not None:
+            # Advertise our endpoint so the response's tensors come
+            # back over the pipe too (the peer negotiates down to MQTT
+            # when it cannot, or when this send's twin fails there).
+            header["pipe_reply"] = self._data_endpoint.location
         if self.telemetry is not None and frame.trace_id is not None:
             # Trace context rides the hop: the remote pipeline stamps
             # its spans under this hop span's id and returns them in
@@ -3021,20 +3402,37 @@ class Pipeline(Actor):
                 frame.remote_span = (node.name, mint_id(), time.time())
             header["trace_id"] = frame.trace_id
             header["trace_parent"] = frame.remote_span[1]
+        # Data plane (ISSUE 9): tensors over the peer's advertised
+        # pipe, control envelope (+ token) on MQTT; any pipe problem
+        # re-inlines the tensors into the MQTT payload -- the frame
+        # always goes out exactly once.
+        body, pipe_bytes = (forwarded, None) \
+            if force_mqtt or self._data_plane_mode == "mqtt" \
+            else self._pipe_ship(stage.remote_pipe, forwarded, header,
+                                 f"forward to {node.name}")
         payload = generate("process_frame",
-                           [header, encode_frame_data(forwarded)])
+                           [header, encode_frame_data(body)])
         self.runtime.message.publish(f"{stage.remote_topic_path}/in",
                                      payload)
+        self._count_plane(pipe_bytes, len(payload))
         return True
 
     def process_frame_response(self, stream_dict=None, frame_data=None):
         """Continuation: a parked frame's remote outputs arrived
-        (reference pipeline.py:1218-1221,1452-1455)."""
+        (reference pipeline.py:1218-1221,1452-1455).  A ``pipe_token``
+        header means the output tensors rode the binary data plane:
+        claim them (deferring until they land, dropping after the
+        claim timeout -- the parked frame then recovers through its
+        deadline/breaker exactly as for a dropped response)."""
         stream_dict = dict(stream_dict or {})
         stream_id = str(stream_dict.get("stream_id", DEFAULT_STREAM_ID))
         stream = self.streams.get(stream_id)
         if stream is None:
             return
+        pipe_claimed = self._claim_pipe_response(stream_dict,
+                                                 dict(frame_data or {}))
+        if pipe_claimed is None:
+            return              # deferred behind the watch, or dropped
         frame_id = int(parse_number(stream_dict.get("frame_id"), -1))
         frame = stream.frames.get(frame_id)
         if frame is None or frame.paused_pe_name is None:
@@ -3067,6 +3465,23 @@ class Pipeline(Actor):
         breaker = self._stage_breaker(frame.paused_pe_name) \
             if frame.paused_pe_name in self.graph else None
         if not okay:
+            if str(stream_dict.get("pipe_retry", "")).strip().lower() \
+                    in ("true", "1") \
+                    and frame.metrics.get("pipe_retries", 0) < 1:
+                # The REMOTE never got our pipe tensors (its claim
+                # timed out): not a remote failure -- a data-plane
+                # loss.  Re-forward this frame with the tensors inlined
+                # into the MQTT payload, once; the breaker is not
+                # charged (the remote answered, the pipe died).
+                node = self.graph.get_node(frame.paused_pe_name)
+                frame.metrics["pipe_retries"] = \
+                    frame.metrics.get("pipe_retries", 0) + 1
+                self._count_pipe_fallback(
+                    f"re-forward to {node.name}",
+                    "peer claim timed out; resending over MQTT")
+                if self._forward_frame(stream, frame, node,
+                                       force_mqtt=True):
+                    return
             if breaker is not None:
                 breaker.record_failure()
             self._frame_error(stream, frame,
@@ -3075,6 +3490,7 @@ class Pipeline(Actor):
             return
         try:
             outputs = decode_frame_data(dict(frame_data or {}))
+            outputs.update(pipe_claimed)
         except Exception as error:
             # A corrupt-but-parseable response payload: counts against
             # the stage's breaker like any other remote failure.
@@ -3157,6 +3573,11 @@ class Pipeline(Actor):
             self._destroy_stream_now(stream_id)
         if self.stage_scheduler is not None:
             self.stage_scheduler.stop()
+        if self._data_endpoint is not None:
+            self._data_endpoint.close()
+            self._data_endpoint = None
+        for sender in self._pipe_senders.values():
+            sender.close()
         super().stop()
 
 

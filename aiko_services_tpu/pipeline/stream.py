@@ -120,6 +120,12 @@ class Frame:
     deadline: float | None = None
     replays: int = 0
     replay_epoch: int = 0
+    # Binary data plane (ISSUE 9): the FORWARDING process's tensor-pipe
+    # endpoint ("host:port"), carried in the process_frame header so
+    # this process can ship the response's tensors back over the pipe
+    # instead of base64'ing them onto the control fabric.  None = the
+    # origin advertises no pipe; the response rides MQTT whole.
+    pipe_reply: str | None = None
     # Elements whose outputs this frame has accepted (map-out ran):
     # the replay frontier.  A replayed frame resumes at the first path
     # node NOT in here -- everything before it is host-visible in the

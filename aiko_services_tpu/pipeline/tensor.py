@@ -20,6 +20,8 @@ elements/media/scheme_zmq.py:40-150).  Here the data plane is TPU-native:
 from __future__ import annotations
 
 import io
+import json
+import os
 from typing import Any, Callable, Sequence
 
 import jax
@@ -32,7 +34,83 @@ from .stream import Stream, StreamEvent
 
 __all__ = ["ShapeBucketer", "JitCache", "StagePlacement", "TPUElement",
            "encode_array", "decode_array", "tree_device_put",
-           "device_sort_key"]
+           "device_sort_key", "distributed_mesh_spec",
+           "ensure_distributed"]
+
+
+# ---------------------------------------------------------------------------
+# Multi-host mesh mode (ISSUE 9): one logical pipeline spanning
+# processes/hosts via jax.distributed, so placed-stage hops ride
+# ICI/DCN through the shared global mesh instead of the broker.
+
+MESH_ENV_HOSTS = "AIKO_MESH_HOSTS"
+MESH_ENV_COORDINATOR = "AIKO_MESH_COORDINATOR"
+MESH_ENV_PROCESS_ID = "AIKO_MESH_PROCESS_ID"
+
+_DISTRIBUTED_STATE = {"initialized": False}
+
+
+def distributed_mesh_spec(parameters) -> dict | None:
+    """The pipeline's multi-host mesh request, or None.
+
+    Sources, in precedence order: the ``mesh`` pipeline parameter
+    (``{"hosts": N, "coordinator": "host:port", "process_id": k}`` --
+    a dict or its JSON string), then the ``AIKO_MESH_*`` environment
+    (hosts / coordinator / process id), so a launcher can mesh-enable
+    an unmodified definition per process.  Raises ValueError on a
+    malformed spec -- the same validation the ``bad-parameter`` lint
+    rule applies at create time."""
+    spec = (parameters or {}).get("mesh")
+    if isinstance(spec, str):
+        try:
+            spec = json.loads(spec)
+        except json.JSONDecodeError as error:
+            raise ValueError(f"mesh: unparseable JSON ({error})")
+    if spec is None:
+        hosts_env = os.environ.get(MESH_ENV_HOSTS)
+        if not hosts_env:
+            return None
+        spec = {"hosts": hosts_env,
+                "coordinator": os.environ.get(MESH_ENV_COORDINATOR),
+                "process_id": os.environ.get(MESH_ENV_PROCESS_ID, 0)}
+    if not isinstance(spec, dict) or "hosts" not in spec:
+        raise ValueError(
+            f"mesh: expected {{'hosts': N, ...}}, got {spec!r}")
+    try:
+        hosts = int(spec["hosts"])
+    except (TypeError, ValueError):
+        raise ValueError(f"mesh: hosts={spec['hosts']!r} is not an "
+                         f"integer")
+    if hosts < 1:
+        raise ValueError(f"mesh: hosts must be >= 1, got {hosts}")
+    try:
+        process_id = int(spec.get("process_id") or 0)
+    except (TypeError, ValueError):
+        raise ValueError(f"mesh: process_id="
+                         f"{spec.get('process_id')!r} is not an "
+                         f"integer")
+    return {"hosts": hosts,
+            "coordinator": spec.get("coordinator") or None,
+            "process_id": process_id}
+
+
+def ensure_distributed(spec: dict | None) -> tuple[int, int]:
+    """Bring up ``jax.distributed`` for a REAL multi-host mesh (a
+    coordinator is configured and more than one host declared), once
+    per process; afterwards ``jax.devices()`` is the GLOBAL pool and
+    :class:`StagePlacement` groups it by ``device.process_index``.
+    Single-process/virtual meshes (no coordinator -- the CI shape)
+    skip the bring-up and carve virtual host groups instead.  Returns
+    (process_index, process_count)."""
+    if spec and spec.get("coordinator") and spec["hosts"] > 1 \
+            and not _DISTRIBUTED_STATE["initialized"] \
+            and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=spec["coordinator"],
+            num_processes=spec["hosts"],
+            process_id=spec["process_id"])
+        _DISTRIBUTED_STATE["initialized"] = True
+    return jax.process_index(), jax.process_count()
 
 
 # ---------------------------------------------------------------------------
@@ -203,6 +281,18 @@ class StagePlacement:
                               else jax.devices(), key=device_sort_key)
         self.plans: dict[str, MeshPlan] = {}
         self._requests: dict = {}
+        # Multi-host mesh mode (ISSUE 9): the pool partitions into
+        # per-host device groups -- by ``device.process_index`` under a
+        # real jax.distributed mesh, or N contiguous virtual groups of
+        # the topology-sorted pool in a single process (the CI shape,
+        # same carving code).  Stages land wholly inside ONE host's
+        # group (``stage_hosts``), so a stage hop between same-host
+        # stages is ICI and a cross-host hop is DCN through the shared
+        # global mesh -- never the broker.
+        self.hosts: int | None = None
+        self.host_groups: list[list] = []
+        self.stage_hosts: dict[str, int] = {}
+        self._stage_host_pins: dict[str, int] = {}
         self.generation = 0             # bumped by every replace()
         self.costs: dict[str, float] = {}    # stage -> EMA seconds/frame
         self._shardings: dict = {}      # (stage, replica, gen, spec) memo
@@ -290,13 +380,20 @@ class StagePlacement:
 
     def assign(self, stages: dict, costs: dict | None = None,
                replicas: dict | None = None,
-               replica_min: dict | None = None) -> dict[str, MeshPlan]:
+               replica_min: dict | None = None,
+               hosts: int | None = None,
+               stage_hosts: dict | None = None) -> dict[str, MeshPlan]:
         """stages: name -> chip count, {axis: size} mesh request, or
         ``"auto"``.  ``costs`` (stage -> seconds) seeds the profile the
         auto split balances on.  ``replicas`` (stage -> N >= 1) splits
         those stages' allocations into N replica submeshes (a fixed
         request then describes ONE replica); ``replica_min`` floors the
-        counts the fit loop may shed to under device loss."""
+        counts the fit loop may shed to under device loss.  ``hosts``
+        > 1 enables mesh mode: the pool partitions into per-host
+        groups and every stage carves wholly inside one group --
+        pinned by ``stage_hosts`` (stage -> host index, the placement
+        block's ``host`` key) or filled greedily in declaration
+        order."""
         if costs:
             for name, seconds in costs.items():
                 self.record_cost(name, float(seconds))
@@ -309,40 +406,132 @@ class StagePlacement:
         if replica_min is not None:
             self._replica_min = {name: max(1, int(count))
                                  for name, count in replica_min.items()}
+        self.hosts = int(hosts) if hosts and int(hosts) > 1 else None
+        self._stage_host_pins = {name: int(index) for name, index
+                                 in (stage_hosts or {}).items()}
         self._carve(requests, replicas)
         return self.plans
 
+    # -- mesh mode: per-host device groups ---------------------------------
+
+    def _host_groups_for(self, devices: list) -> list[list]:
+        """Partition ``devices`` into per-host groups: by the real
+        ``process_index`` when a jax.distributed mesh spans processes,
+        else ``self.hosts`` contiguous chunks of the topology-sorted
+        pool (virtual hosts -- single-process reproduction of the
+        multi-host carve, same code path)."""
+        by_process: dict[int, list] = {}
+        for device in devices:
+            by_process.setdefault(
+                int(getattr(device, "process_index", 0) or 0),
+                []).append(device)
+        if len(by_process) > 1:
+            return [by_process[key] for key in sorted(by_process)]
+        count = self.hosts or 1
+        base, rem = divmod(len(devices), count)
+        groups, pos = [], 0
+        for index in range(count):
+            size = base + (1 if index < rem else 0)
+            groups.append(devices[pos:pos + size])
+            pos += size
+        return groups
+
+    def stage_host(self, stage: str) -> int | None:
+        """Which host group a stage is placed on (None outside mesh
+        mode)."""
+        return self.stage_hosts.get(stage) if self.hosts else None
+
+    def same_host(self, stage_a: str, stage_b: str) -> bool:
+        """True when a hop between the stages stays inside one host's
+        ICI domain (always true outside mesh mode: one host)."""
+        if not self.hosts:
+            return True
+        return self.stage_hosts.get(stage_a) \
+            == self.stage_hosts.get(stage_b)
+
     def _carve(self, requests: dict, replicas: dict) -> None:
         """Cut the topology-sorted pool into per-stage chunks (and
-        per-replica sub-chunks) for already-fitted requests."""
+        per-replica sub-chunks) for already-fitted requests; in mesh
+        mode every chunk comes wholly from one host group."""
         resolved = self._resolve(requests, len(self.devices), replicas)
         self.plans = {}
         self.replica_plans = {}
+        if self.hosts:
+            self._carve_hosted(requests, replicas, resolved)
+            return
         cursor = 0
         for name, axes in requests.items():
             total = resolved[name]
             chunk = self.devices[cursor:cursor + total]
             cursor += total
-            if name in replicas:
-                count = replicas[name]
-                subs, pos = [], 0
-                base, rem = divmod(total, count)
-                for index in range(count):
-                    size = base + (1 if index < rem else 0)
-                    sub = chunk[pos:pos + size]
-                    pos += size
-                    sub_axes = dict(axes) if axes != "auto" \
-                        else {"dp": size}
-                    subs.append(MeshPlan(make_mesh(sub_axes, sub)))
-                self.replica_plans[name] = subs
-                # The whole-stage plan (stage_devices, default hops,
-                # stats) spans every replica's chips as one dp pool.
-                self.plans[name] = MeshPlan(
-                    make_mesh({"dp": total}, chunk))
+            self._place_chunk(name, axes, chunk, replicas)
+
+    def _carve_hosted(self, requests: dict, replicas: dict,
+                      resolved: dict) -> None:
+        groups = self._host_groups_for(self.devices)
+        self.host_groups = groups
+        self.stage_hosts = {}
+        cursors = [0] * len(groups)
+        fill = 0
+        for name, axes in requests.items():
+            total = resolved[name]
+            pin = self._stage_host_pins.get(name)
+            if pin is not None:
+                if not 0 <= pin < len(groups):
+                    raise ValueError(
+                        f"stage {name!r}: host {pin} out of range "
+                        f"(mesh has {len(groups)} hosts)")
+                if len(groups[pin]) - cursors[pin] < total:
+                    raise ValueError(
+                        f"stage {name!r} wants {total} chips on host "
+                        f"{pin}, which has "
+                        f"{len(groups[pin]) - cursors[pin]} free")
+                host = pin
             else:
-                plan_axes = dict(axes) if axes != "auto" \
-                    else {"dp": total}
-                self.plans[name] = MeshPlan(make_mesh(plan_axes, chunk))
+                host = None
+                for offset in range(len(groups)):
+                    candidate = (fill + offset) % len(groups)
+                    if len(groups[candidate]) - cursors[candidate] \
+                            >= total:
+                        host = candidate
+                        break
+                if host is None:
+                    raise ValueError(
+                        f"stage {name!r} wants {total} chips but no "
+                        f"host group has that many free (a stage "
+                        f"never spans hosts -- its submesh must fit "
+                        f"one ICI domain)")
+                fill = host
+            chunk = groups[host][cursors[host]:cursors[host] + total]
+            cursors[host] += total
+            self.stage_hosts[name] = host
+            self._place_chunk(name, axes, chunk, replicas)
+
+    def _place_chunk(self, name: str, axes, chunk: list,
+                     replicas: dict) -> None:
+        """Build a stage's MeshPlan (and replica sub-plans) from its
+        carved device chunk -- shared by the flat and hosted carves."""
+        total = len(chunk)
+        if name in replicas:
+            count = replicas[name]
+            subs, pos = [], 0
+            base, rem = divmod(total, count)
+            for index in range(count):
+                size = base + (1 if index < rem else 0)
+                sub = chunk[pos:pos + size]
+                pos += size
+                sub_axes = dict(axes) if axes != "auto" \
+                    else {"dp": size}
+                subs.append(MeshPlan(make_mesh(sub_axes, sub)))
+            self.replica_plans[name] = subs
+            # The whole-stage plan (stage_devices, default hops,
+            # stats) spans every replica's chips as one dp pool.
+            self.plans[name] = MeshPlan(
+                make_mesh({"dp": total}, chunk))
+        else:
+            plan_axes = dict(axes) if axes != "auto" \
+                else {"dp": total}
+            self.plans[name] = MeshPlan(make_mesh(plan_axes, chunk))
 
     def record_cost(self, stage: str, seconds: float) -> None:
         """EMA of the measured per-frame cost of a stage (fed from the
@@ -583,6 +772,11 @@ class StagePlacement:
                        else int(plan.mesh.devices.size)
                        for plan in plans]
                 for name, plans in self.replica_plans.items()}
+        if self.hosts:
+            result["hosts"] = self.hosts
+            result["host_groups"] = [len(group)
+                                     for group in self.host_groups]
+            result["stage_hosts"] = dict(self.stage_hosts)
         return result
 
 

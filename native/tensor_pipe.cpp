@@ -61,6 +61,12 @@ int read_exact(int fd, void* buffer, uint64_t n, int timeout_ms) {
     return 0;
 }
 
+// A send that makes NO progress for this long means the peer is
+// wedged (window full, reader dead), not merely slow: fail the send
+// so the caller's fallback/breaker machinery can run.  Unbounded
+// blocking here would freeze the sending event loop forever.
+constexpr int kSendStallMs = 10000;
+
 int write_exact(int fd, const void* buffer, uint64_t n) {
     auto* in = static_cast<const uint8_t*>(buffer);
     uint64_t done = 0;
@@ -70,9 +76,11 @@ int write_exact(int fd, const void* buffer, uint64_t n) {
             if (errno == EINTR) continue;
             if (errno == EAGAIN || errno == EWOULDBLOCK) {
                 // Kernel buffer full (slow receiver): wait for space
-                // rather than tearing the stream mid-frame.
+                // rather than tearing the stream mid-frame -- but only
+                // bounded; zero progress past the stall cap is a dead
+                // peer.
                 pollfd p{fd, POLLOUT, 0};
-                if (::poll(&p, 1, -1) <= 0) return -1;
+                if (::poll(&p, 1, kSendStallMs) <= 0) return -1;
                 continue;
             }
             return -1;

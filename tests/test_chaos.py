@@ -1093,3 +1093,135 @@ def test_decode_block_hang_delays_but_completes(runtime):
     assert batcher.recoveries == 0
     assert pipeline.fault_stats()["plan"]["fired"] == {"decode_block": 1}
     pipeline.stop()
+
+
+# -- (e) wire-fault parity on the tensor-pipe data plane (ISSUE 9) -----------
+#
+# The control envelope still rides MQTT when tensors take the pipe, so
+# every ``wire_*`` rule must fire on a pipe-data-plane pipeline with
+# the SAME blast radius and the SAME recovery (deadline -> breaker ->
+# reclose; dup discard) the MQTT path shows -- chaos coverage must not
+# narrow when the data moves off the broker.
+
+
+def _pipe_remote_pair(runtime, **front_params):
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    back = Pipeline(
+        {"version": 0, "name": "back", "runtime": "jax",
+         "graph": ["(inc)"],
+         "elements": [element("inc", "Identity",
+                              module="aiko_services_tpu.elements"
+                                     ".common")]},
+        runtime=runtime)
+    front = Pipeline(
+        {"version": 0, "name": "front", "runtime": "jax",
+         "graph": ["(fwd)"],
+         "parameters": {"frame_deadline_ms": 400,
+                        "breaker_threshold": 2,
+                        "breaker_cooldown_ms": 250, **front_params},
+         "elements": [
+             {"name": "fwd", "input": [{"name": "x"}],
+              "output": [{"name": "x"}],
+              "deploy": {"remote": {"name": "back"}}}]},
+        runtime=runtime)
+    stage = front.graph.get_node("fwd").element
+    assert run_until(runtime,
+                     lambda: stage.remote_topic_path is not None,
+                     timeout=10.0)
+    assert stage.remote_pipe is not None      # pipe negotiated
+    return front, back
+
+
+def test_wire_drop_parity_on_tensor_pipe_path(runtime):
+    """wire_drop of responses on a PIPE-data-plane pipeline: the exact
+    MQTT-path walk -- two deadline misses open the breaker, fail-fast,
+    half-open probe recloses once the wire heals -- with tensors
+    verifiably riding the pipe and EXACTLY two rule firings."""
+    front, back = _pipe_remote_pair(runtime)
+    responses = queue.Queue()
+    x = np.arange(4096, dtype=np.float32)
+    front.create_stream_local("w", {"frame_deadline_ms": 0},
+                              queue_response=responses)
+    front.ingest_local("w", {"x": x}, queue_response=responses)
+    warm = collect(runtime, responses, 1)
+    assert warm and warm[0][4], warm[0]
+    assert front.data_plane_stats()["pipe_frames"] >= 1
+    front.create_stream_local("1", queue_response=responses)
+
+    front.arm_faults({"rules": [
+        {"point": "wire_drop", "target": "process_frame_response",
+         "count": 2}]})
+    for _ in range(2):
+        front.ingest_local("1", {"x": x}, queue_response=responses)
+        rows = collect(runtime, responses, 1, timeout=10.0)
+        assert rows and not rows[0][4]
+        assert "deadline" in rows[0][5]
+    breaker = front.breakers["fwd"]
+    assert breaker.state == BREAKER_OPEN
+    assert front.share["deadline_misses"] == 2
+
+    front.ingest_local("1", {"x": x}, queue_response=responses)
+    rows = collect(runtime, responses, 1, timeout=10.0)
+    assert rows and not rows[0][4]
+    assert "circuit breaker open" in rows[0][5]
+    assert "1" in front.streams                  # stream alive
+
+    time.sleep(0.3)
+    front.ingest_local("1", {"x": x}, queue_response=responses)
+    rows = collect(runtime, responses, 1, timeout=10.0)
+    assert rows and rows[0][4], rows[0][5]
+    np.testing.assert_array_equal(np.asarray(rows[0][2]["x"]), x)
+    assert breaker.state == BREAKER_CLOSED
+    assert [s for s, _ in breaker.transitions] == \
+        ["open", "half_open", "closed"]
+    # Exact blast radius, via the plan trace -- identical to MQTT.
+    plan = front.fault_stats()["plan"]
+    assert plan["fired"]["wire_drop"] == 2
+    assert len([t for t in plan["trace"]
+                if t["point"] == "wire_drop"]) == 2
+    # The recovered frames still used the pipe for their tensors.
+    assert front.data_plane_stats()["pipe_frames"] >= 3
+    front.stop()
+    back.stop()
+
+
+def test_wire_corrupt_and_dup_parity_on_tensor_pipe_path(runtime):
+    """wire_corrupt of a process_frame envelope on the pipe path: the
+    receiver's parse drops it (same as MQTT), the parked frame
+    deadline-fails without killing the stream, the next frame flows.
+    wire_dup of a response: the duplicate is discarded once the frame
+    moved on -- one delivery, correct value."""
+    front, back = _pipe_remote_pair(runtime)
+    responses = queue.Queue()
+    x = np.arange(1024, dtype=np.int32)
+    front.create_stream_local("w", {"frame_deadline_ms": 0},
+                              queue_response=responses)
+    front.ingest_local("w", {"x": x}, queue_response=responses)
+    warm = collect(runtime, responses, 1)
+    assert warm and warm[0][4], warm[0]
+
+    front.create_stream_local("1", queue_response=responses)
+    front.arm_faults({"rules": [
+        {"point": "wire_corrupt", "target": "process_frame",
+         "count": 1}]})
+    front.ingest_local("1", {"x": x}, queue_response=responses)
+    rows = collect(runtime, responses, 1, timeout=10.0)
+    assert rows and not rows[0][4]
+    assert "deadline" in rows[0][5]
+    assert "1" in front.streams                  # stream alive
+    front.ingest_local("1", {"x": x}, queue_response=responses)
+    rows = collect(runtime, responses, 1, timeout=10.0)
+    assert rows and rows[0][4], rows[0][5]
+
+    front.arm_faults({"rules": [
+        {"point": "wire_dup", "target": "process_frame_response",
+         "count": 1}]})
+    front.ingest_local("1", {"x": x}, queue_response=responses)
+    rows = collect(runtime, responses, 2, timeout=5.0)
+    assert len(rows) == 1                        # duplicate discarded
+    assert rows[0][4], rows[0][5]
+    np.testing.assert_array_equal(np.asarray(rows[0][2]["x"]), x)
+    plan = front.fault_stats()["plan"]
+    assert plan["fired"] == {"wire_dup": 1}      # re-armed plan
+    front.stop()
+    back.stop()

@@ -1,0 +1,505 @@
+"""Binary multi-host data plane (ISSUE 9): control/data split for
+remote-stage frames -- tensors over the tensor pipe (negotiated via the
+registrar record's ``tensor_pipe=`` tag), envelopes on MQTT -- plus the
+pure-Python framing fallback, counted drops and fallbacks, the
+never-lose-a-frame recovery on pipe death, distributed traces riding
+the new path, and the ``mesh: {hosts: N}`` multi-host mesh mode."""
+
+import json
+import queue
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import run_until
+
+from aiko_services_tpu.pipeline import Pipeline
+from aiko_services_tpu.pipeline.data_plane import (PipeSender,
+                                                   TensorPipeEndpoint,
+                                                   split_arrays)
+from aiko_services_tpu.pipeline.definition import DefinitionError
+from aiko_services_tpu.services import Registrar
+from aiko_services_tpu.transport.tensor_pipe import (
+    PyTensorPipeClient, PyTensorPipeServer, TensorPipeClient,
+    TensorPipeServer, create_pipe_client, create_pipe_server,
+    native_pipe_available)
+
+COMMON = "aiko_services_tpu.elements.common"
+
+
+def element(name, cls, module=COMMON):
+    return {"name": name, "input": [{"name": "x"}],
+            "output": [{"name": "x"}],
+            "deploy": {"local": {"module": module, "class_name": cls}}}
+
+
+def remote(name, target):
+    return {"name": name, "input": [{"name": "x"}],
+            "output": [{"name": "x"}],
+            "deploy": {"remote": {"name": target}}}
+
+
+def remote_pair(runtime, front_params=None, back_params=None,
+                back_cls="Identity"):
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    back = Pipeline({"version": 0, "name": "back", "runtime": "jax",
+                     "graph": ["(inc)"],
+                     "parameters": dict(back_params or {}),
+                     "elements": [element("inc", back_cls)]},
+                    runtime=runtime)
+    front = Pipeline({"version": 0, "name": "front", "runtime": "jax",
+                      "graph": ["(fwd)"],
+                      "parameters": dict(front_params or {}),
+                      "elements": [remote("fwd", "back")]},
+                     runtime=runtime)
+    stage = front.graph.get_node("fwd").element
+    assert run_until(runtime,
+                     lambda: stage.remote_topic_path is not None,
+                     timeout=10.0)
+    return front, back, stage
+
+
+def collect(runtime, responses, count, timeout=30.0):
+    rows = []
+
+    def drained():
+        while not responses.empty():
+            rows.append(responses.get())
+        return len(rows) >= count
+
+    run_until(runtime, drained, timeout=timeout)
+    return rows
+
+
+# -- pure-Python framing fallback (same wire format) ------------------------
+
+
+def test_python_fallback_selected_and_round_trips(monkeypatch):
+    monkeypatch.setenv("AIKO_TENSOR_PIPE_NATIVE", "0")
+    assert not native_pipe_available()
+    with create_pipe_server() as server:
+        assert isinstance(server, PyTensorPipeServer)
+        with create_pipe_client("127.0.0.1", server.port) as client:
+            assert isinstance(client, PyTensorPipeClient)
+            cases = [np.arange(24, dtype=np.int32).reshape(2, 3, 4),
+                     np.zeros((0,), np.float64),
+                     np.asarray(jnp.ones((4, 5), jnp.bfloat16))]
+            for i, case in enumerate(cases):
+                client.send(case, name=f"case{i}")
+            for i, case in enumerate(cases):
+                name, got = server.recv(timeout=5.0)
+                assert name == f"case{i}"
+                assert got.dtype == case.dtype
+                assert got.shape == case.shape
+                np.testing.assert_array_equal(got, case)
+
+
+@pytest.mark.skipif(not native_pipe_available(),
+                    reason="native tensor_pipe unavailable")
+def test_python_framing_interops_with_native_both_directions():
+    payload = np.arange(6, dtype=np.int16).reshape(2, 3)
+    with TensorPipeServer() as server:
+        with PyTensorPipeClient("127.0.0.1", server.port) as client:
+            client.send(payload, name="py->c")
+            name, got = server.recv(timeout=5.0)
+            assert name == "py->c"
+            np.testing.assert_array_equal(got, payload)
+    with PyTensorPipeServer() as server:
+        with TensorPipeClient("127.0.0.1", server.port) as client:
+            client.send(payload, name="c->py")
+            name, got = server.recv(timeout=5.0)
+            assert name == "c->py"
+            np.testing.assert_array_equal(got, payload)
+
+
+def test_server_counts_drops_and_logs_first_per_connection():
+    """Drop-oldest evictions are COUNTED (``server.dropped``), no
+    longer silent -- the pipeline shares the number as
+    ``tensor_pipe_dropped_frames``."""
+    with create_pipe_server(queue_depth=2) as server:
+        with create_pipe_client("127.0.0.1", server.port) as client:
+            for i in range(10):
+                client.send(np.asarray([i], np.int32))
+            deadline = time.monotonic() + 5.0
+            while server.dropped == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert server.dropped > 0
+            # Newest survive, order preserved (the policy unchanged).
+            survivors = []
+            while True:
+                frame = server.recv(timeout=0.5)
+                if frame is None:
+                    break
+                survivors.append(int(frame[1][0]))
+            assert survivors and survivors[-1] == 9
+            assert survivors == sorted(survivors)
+
+
+# -- endpoint claim/watch/expiry --------------------------------------------
+
+
+def test_endpoint_claim_watch_and_expiry():
+    endpoint = TensorPipeEndpoint(claim_timeout_s=0.3)
+    try:
+        sender = PipeSender(endpoint.location)
+        arrays = {"x": np.arange(8, dtype=np.float32),
+                  "b": np.asarray(jnp.ones((2, 2), jnp.bfloat16))}
+        sent = sender.send("tok1", arrays)
+        assert sent and sent > arrays["x"].nbytes
+        deadline = time.monotonic() + 5.0
+        claimed = None
+        while claimed is None and time.monotonic() < deadline:
+            claimed = endpoint.claim("tok1", ["x", "b"])
+            time.sleep(0.01)
+        assert claimed is not None
+        np.testing.assert_array_equal(claimed["x"], arrays["x"])
+        assert claimed["b"].dtype == jnp.bfloat16     # tag restored
+        # A duplicate claim still answers (dup-envelope parity).
+        assert endpoint.claim("tok1", ["x"]) is not None
+        # Watch on a complete token fires inline.
+        fired = []
+        endpoint.watch("tok1", ["x"], lambda: fired.append("now"))
+        assert fired == ["now"]
+        # Watch on a token that never completes fires at the claim
+        # timeout and counts the expiry.
+        endpoint.watch("ghost", ["x"], lambda: fired.append("late"))
+        deadline = time.monotonic() + 5.0
+        while len(fired) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fired == ["now", "late"]
+        assert endpoint.claims_expired == 1
+        assert endpoint.claim("ghost", ["x"]) is None
+        sender.close()
+    finally:
+        endpoint.close()
+
+
+def test_split_arrays_matches_codec_predicate():
+    data = {"image": np.zeros((2, 2)), "scalar": 3, "text": "hi",
+            "flags": [1, 2], "np_scalar": np.float32(1.0)}
+    assert sorted(split_arrays(data)) == ["image", "np_scalar"]
+
+
+# -- remote hop over the pipe (negotiation, bytes, fallback) -----------------
+
+
+def test_remote_hop_rides_pipe_and_counts(runtime):
+    front, back, stage = remote_pair(runtime)
+    assert stage.remote_pipe is not None          # negotiated via tag
+    responses = queue.Queue()
+    x = np.arange(256 * 256, dtype=np.uint8).reshape(256, 256)
+    for _ in range(3):
+        front.process_frame_local({"x": x}, stream_id="s",
+                                  queue_response=responses)
+    rows = collect(runtime, responses, 3)
+    assert len(rows) == 3 and all(row[4] for row in rows), rows
+    for row in rows:
+        np.testing.assert_array_equal(np.asarray(row[2]["x"]), x)
+    front_stats = front.data_plane_stats()
+    back_stats = back.data_plane_stats()
+    assert front_stats["pipe_frames"] == 3        # forwards
+    assert back_stats["pipe_frames"] == 3         # responses
+    assert front_stats["fallbacks"] == 0
+    assert front.share["data_plane_frames"] == 3
+    front.stop()
+    back.stop()
+
+
+def test_pipe_payload_byte_ratio_beats_base64(runtime):
+    """The byte-tax acceptance: wire bytes per frame on the pipe path
+    stay within 1.05x of the raw payload (forward + response), where
+    the base64 MQTT path pays ~1.33x."""
+    front, back, _ = remote_pair(runtime)
+    responses = queue.Queue()
+    x = np.random.default_rng(0).integers(
+        0, 255, (512, 2048), dtype=np.uint8)      # 1 MB
+    front.process_frame_local({"x": x}, stream_id="s",
+                              queue_response=responses)
+    rows = collect(runtime, responses, 1)
+    assert rows and rows[0][4], rows
+    fs, bs = front.data_plane_stats(), back.data_plane_stats()
+    wire = fs["pipe_bytes"] + fs["mqtt_bytes"] \
+        + bs["pipe_bytes"] + bs["mqtt_bytes"]
+    assert wire / (2 * x.nbytes) <= 1.05, wire
+    front.stop()
+    back.stop()
+
+    # Same frame forced onto MQTT: the base64 tax for contrast.
+    mqtt_front, mqtt_back, _ = remote_pair_mqtt(runtime)
+    mqtt_front.process_frame_local({"x": x}, stream_id="s",
+                                   queue_response=responses)
+    rows = collect(runtime, responses, 1)
+    assert rows and rows[0][4], rows
+    fs, bs = mqtt_front.data_plane_stats(), mqtt_back.data_plane_stats()
+    wire = fs["mqtt_bytes"] + bs["mqtt_bytes"]
+    assert wire / (2 * x.nbytes) >= 1.2, wire
+    mqtt_front.stop()
+    mqtt_back.stop()
+
+
+def remote_pair_mqtt(runtime):
+    back = Pipeline({"version": 0, "name": "back_m", "runtime": "jax",
+                     "graph": ["(inc)"],
+                     "parameters": {"data_plane": "mqtt"},
+                     "elements": [element("inc", "Identity")]},
+                    runtime=runtime)
+    front = Pipeline({"version": 0, "name": "front_m", "runtime": "jax",
+                      "graph": ["(fwd)"],
+                      "parameters": {"data_plane": "mqtt"},
+                      "elements": [remote("fwd", "back_m")]},
+                     runtime=runtime)
+    stage = front.graph.get_node("fwd").element
+    assert run_until(runtime,
+                     lambda: stage.remote_topic_path is not None,
+                     timeout=10.0)
+    return front, back, stage
+
+
+def test_peer_without_pipe_negotiates_mqtt_counted(runtime):
+    """A peer advertising no ``tensor_pipe=`` tag rides the MQTT
+    payload path -- automatically, and COUNTED, never silent."""
+    front, back, stage = remote_pair(
+        runtime, back_params={"data_plane": "mqtt"})
+    assert stage.remote_pipe is None              # nothing advertised
+    responses = queue.Queue()
+    x = np.arange(64, dtype=np.float32)
+    front.process_frame_local({"x": x}, stream_id="s",
+                              queue_response=responses)
+    rows = collect(runtime, responses, 1)
+    assert rows and rows[0][4], rows
+    np.testing.assert_array_equal(np.asarray(rows[0][2]["x"]), x)
+    stats = front.data_plane_stats()
+    assert stats["pipe_frames"] == 0
+    assert stats["fallbacks"] >= 1
+    assert front.share["data_plane_fallbacks"] >= 1
+    front.stop()
+    back.stop()
+
+
+def test_data_plane_mqtt_mode_binds_nothing(runtime):
+    pipeline = Pipeline({"version": 0, "name": "p_mqtt",
+                         "runtime": "jax", "graph": ["(inc)"],
+                         "parameters": {"data_plane": "mqtt"},
+                         "elements": [element("inc", "Increment")]},
+                        runtime=runtime)
+    assert pipeline._data_endpoint is None
+    assert not any(tag.startswith("tensor_pipe=")
+                   for tag in pipeline.tags)
+    responses = queue.Queue()
+    pipeline.process_frame_local({"x": 1}, stream_id="s",
+                                 queue_response=responses)
+    rows = collect(runtime, responses, 1)
+    assert rows and rows[0][4] and int(rows[0][2]["x"]) == 2
+    pipeline.stop()
+
+
+# -- pipe death: fallback + recovery, never a lost frame ---------------------
+
+
+def test_pipe_death_midstream_falls_back_and_completes_in_order(runtime):
+    """ISSUE 9 acceptance: kill the remote's pipe endpoint mid-stream.
+    Every subsequent frame still completes, in order -- either the
+    send fails synchronously (immediate MQTT fallback) or the bytes
+    die in a kernel buffer and the peer's claim timeout triggers the
+    counted MQTT re-forward.  The stream never dies, no frame is
+    lost."""
+    front, back, _ = remote_pair(
+        runtime,
+        # Short claim timeout so the stranded-bytes recovery path runs
+        # inside the test budget.
+        back_params={"pipe_claim_timeout_ms": 400})
+    responses = queue.Queue()
+    x = np.arange(64 * 1024, dtype=np.uint8)
+    front.process_frame_local({"x": x}, stream_id="s",
+                              queue_response=responses)
+    rows = collect(runtime, responses, 1)
+    assert rows and rows[0][4], rows             # warm: pipe works
+    assert front.data_plane_stats()["pipe_frames"] == 1
+
+    back._data_endpoint.close()                  # the pipe dies
+    for i in range(4):
+        front.process_frame_local({"x": x + (i % 7)}, stream_id="s",
+                                  queue_response=responses)
+    rows = collect(runtime, responses, 4, timeout=60.0)
+    assert len(rows) == 4
+    assert all(row[4] for row in rows), \
+        [row[5] for row in rows if not row[4]]
+    # In order, values intact.
+    for i, row in enumerate(rows):
+        np.testing.assert_array_equal(np.asarray(row[2]["x"]),
+                                      x + (i % 7))
+    assert front.data_plane_stats()["fallbacks"] >= 1
+    assert "s" in front.streams                  # stream alive
+    front.stop()
+    back.stop()
+
+
+# -- distributed trace on the pipe path --------------------------------------
+
+
+def test_trace_spans_both_processes_on_pipe_path(runtime):
+    front, back, stage = remote_pair(runtime)
+    assert stage.remote_pipe is not None
+    responses = queue.Queue()
+    front.process_frame_local({"x": np.arange(16, dtype=np.float32)},
+                              stream_id="s", queue_response=responses)
+    rows = collect(runtime, responses, 1)
+    assert rows and rows[0][4], rows
+    assert front.data_plane_stats()["pipe_frames"] == 1
+    trace = front.telemetry.traces.recent(1)[0]
+    spans = trace["spans"]
+    assert {span["trace_id"] for span in spans} == {trace["trace_id"]}
+    assert {span["process"] for span in spans} == {"front", "back"}
+    hop = next(s for s in spans if s["name"] == "remote:fwd")
+    remote_root = next(s for s in spans if s["kind"] == "frame"
+                       and s["process"] == "back")
+    assert remote_root["parent_id"] == hop["span_id"]
+    front.stop()
+    back.stop()
+
+
+# -- multi-host mesh mode ----------------------------------------------------
+
+
+def test_mesh_mode_carves_host_groups_and_serves(runtime):
+    import jax
+
+    n = len(jax.devices())
+    assert n >= 4
+    pipeline = Pipeline(
+        {"version": 0, "name": "p_mesh", "runtime": "jax",
+         "graph": ["(det llm)"],
+         "parameters": {"mesh": {"hosts": 2}},
+         "elements": [
+             {"name": "det", "input": [{"name": "x"}],
+              "output": [{"name": "x"}],
+              "parameters": {"busy_ms": 1.0},
+              "placement": {"devices": n // 2},
+              "deploy": {"local": {"module": COMMON,
+                                   "class_name": "StageWork"}}},
+             {"name": "llm", "input": [{"name": "x"}],
+              "output": [{"name": "x"}],
+              "parameters": {"busy_ms": 1.0},
+              "placement": {"devices": n // 2, "host": 1},
+              "deploy": {"local": {"module": COMMON,
+                                   "class_name": "StageWork"}}}]},
+        runtime=runtime)
+    placement = pipeline.stage_placement
+    assert placement.hosts == 2
+    assert [len(group) for group in placement.host_groups] == \
+        [n - n // 2, n // 2]
+    assert placement.stage_hosts == {"det": 0, "llm": 1}
+    assert not placement.same_host("det", "llm")
+    assert placement.stage_host("llm") == 1
+    # Stages stay wholly inside their host group's devices.
+    for stage, host in placement.stage_hosts.items():
+        assert placement.stage_devices(stage) <= \
+            set(placement.host_groups[host])
+    # Frames flow across the cross-host hop (DCN through the shared
+    # mesh -- placement.transfer, not the broker).
+    responses = queue.Queue()
+    x = np.ones((8, 8), dtype=np.float32)
+    for _ in range(4):
+        pipeline.process_frame_local({"x": x}, stream_id="s",
+                                     queue_response=responses)
+    rows = collect(runtime, responses, 4, timeout=60.0)
+    assert len(rows) == 4 and all(row[4] for row in rows), rows
+    assert placement.stats["stage_hosts"] == {"det": 0, "llm": 1}
+    pipeline.stop()
+
+
+def test_mesh_parameter_validation_at_create(runtime):
+    broken = {"version": 0, "name": "p_mesh_bad", "runtime": "jax",
+              "graph": ["(det)"],
+              "parameters": {"mesh": {"hosts": 0}},
+              "elements": [
+                  {"name": "det", "input": [{"name": "x"}],
+                   "output": [{"name": "x"}],
+                   "placement": {"devices": 2},
+                   "deploy": {"local": {"module": COMMON,
+                                        "class_name": "StageWork"}}}]}
+    with pytest.raises(DefinitionError, match="mesh"):
+        Pipeline(broken, runtime=runtime)
+
+
+def test_mesh_stage_that_spans_hosts_rejected(runtime):
+    import jax
+
+    n = len(jax.devices())
+    broken = {"version": 0, "name": "p_mesh_span", "runtime": "jax",
+              "graph": ["(det)"],
+              # Lint would pass (the block is well-formed); the carve
+              # itself must refuse a stage bigger than one host group.
+              "parameters": {"mesh": {"hosts": 2}, "preflight": "off"},
+              "elements": [
+                  {"name": "det", "input": [{"name": "x"}],
+                   "output": [{"name": "x"}],
+                   "placement": {"devices": n},
+                   "deploy": {"local": {"module": COMMON,
+                                        "class_name": "StageWork"}}}]}
+    with pytest.raises(DefinitionError, match="never spans hosts"):
+        Pipeline(broken, runtime=runtime)
+
+
+def test_placement_host_key_validated():
+    from aiko_services_tpu.pipeline.definition import placement_error
+
+    assert placement_error({"devices": 2, "host": 1}) is None
+    assert "host" in placement_error({"devices": 2, "host": -1})
+    assert "host" in placement_error({"devices": 2, "host": True})
+    assert "host" in placement_error({"devices": 2, "host": "0"})
+
+
+def test_mesh_env_spec(monkeypatch):
+    from aiko_services_tpu.pipeline.tensor import distributed_mesh_spec
+
+    monkeypatch.setenv("AIKO_MESH_HOSTS", "2")
+    monkeypatch.setenv("AIKO_MESH_PROCESS_ID", "1")
+    spec = distributed_mesh_spec({})
+    assert spec["hosts"] == 2 and spec["process_id"] == 1
+    # The pipeline parameter wins over the env.
+    spec = distributed_mesh_spec({"mesh": {"hosts": 4}})
+    assert spec["hosts"] == 4 and spec["process_id"] == 0
+
+
+def test_py_server_tears_stalled_midframe_connection():
+    """A peer that sends a frame prefix then stalls must not pin the
+    reader forever (review hardening): the bounded mid-frame timeout
+    tears the connection, and fresh connections keep working."""
+    import socket
+    import struct
+
+    with PyTensorPipeServer() as server:
+        server._BODY_TIMEOUT_S = 0.3
+        raw = socket.create_connection(("127.0.0.1", server.port))
+        raw.sendall(struct.pack("<IIQ", 0x54504950, 64, 128))
+        time.sleep(1.0)              # reader gives up on the stall
+        with PyTensorPipeClient("127.0.0.1", server.port) as client:
+            client.send(np.asarray([5], np.int32), name="ok")
+            frame = server.recv(timeout=5.0)
+            assert frame is not None and frame[0] == "ok"
+        raw.close()
+
+
+def test_endpoint_counts_capacity_evictions():
+    """Unclaimed tokens squeezed out by capacity pressure are COUNTED
+    (review hardening): their envelopes pay the claim-timeout + MQTT
+    re-forward, which must be visible, not a silent latency cliff."""
+    endpoint = TensorPipeEndpoint(claim_timeout_s=5.0, capacity=2)
+    try:
+        sender = PipeSender(endpoint.location)
+        for i in range(4):
+            assert sender.send(f"tok{i}", {"x": np.asarray([i])})
+        deadline = time.monotonic() + 5.0
+        while endpoint.tokens_evicted < 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert endpoint.tokens_evicted >= 2
+        assert endpoint.stats["tokens_evicted"] >= 2
+        # The newest tokens survived and still claim.
+        assert endpoint.claim("tok3", ["x"]) is not None
+        sender.close()
+    finally:
+        endpoint.close()

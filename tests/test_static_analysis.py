@@ -35,6 +35,8 @@ DEFINITION_FIXTURES = {
     "placement_remote.json": "placement-remote",
     "bad_parameter.json": "bad-parameter",
     "bad_element_parameter.json": "bad-parameter",
+    "bad_data_plane.json": "bad-parameter",
+    "data_plane_on_local.json": "data-plane-on-local",
     "bad_source.py": "bad-source",
     "undeclared_host_input.json": "undeclared-host-input",
     "device_fn_host_call.json": "device-fn-host-call",
