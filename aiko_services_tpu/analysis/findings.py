@@ -132,6 +132,10 @@ RULES: dict[str, tuple[str, str]] = {
                            "pipeline parameter read in source but "
                            "missing from the registry/README, or "
                            "registered but never read"),
+    "metric-registry": (ERROR,
+                        "metric series emitted in source but missing "
+                        "from the README metrics table, or documented "
+                        "there but never emitted"),
 }
 
 

@@ -105,6 +105,22 @@ PIPELINE_PARAMETERS: dict[str, ParamSpec] = {
         number=True, minimum=0),
     "trace_capacity": ParamSpec(
         "bounded TraceBuffer size", number=True, minimum=1),
+    # -- flight recorder + black-box (ISSUE 10) ------------------------
+    "recorder": ParamSpec(
+        "always-on flight recorder of typed engine events "
+        "(off = None, every emission site no-ops)",
+        choices=("on", "off", "true", "false", "0", "1")),
+    "recorder_capacity": ParamSpec(
+        "flight-recorder ring size in events",
+        number=True, minimum=64),
+    "blackbox_dir": ParamSpec(
+        "directory for black-box dumps on deadline miss / replay / "
+        "breaker open / replica failover / stream error "
+        "(absent = no dumps; needs the recorder on -- dumps are ring "
+        "snapshots)"),
+    "blackbox_limit": ParamSpec(
+        "black-box dump files kept (oldest pruned)",
+        number=True, minimum=1),
     "compile_cache_dir": ParamSpec(
         "persistent XLA compile cache directory"),
     "fault_plan": ParamSpec(

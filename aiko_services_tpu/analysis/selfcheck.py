@@ -22,6 +22,14 @@ returns :class:`~.findings.Finding`s:
                       reads is registered in ``analysis.params`` and
                       documented in README.md, and every registered
                       parameter is still read somewhere.
+- ``metric-registry`` every counter/gauge/histogram series name the
+                      package emits (``registry.observe/count/gauge``
+                      literals, f-string families as wildcards)
+                      appears in the README metrics table (the
+                      ``<!-- metrics-table -->`` fenced region) and
+                      every table row is still emitted somewhere --
+                      the replica/LLM/data-plane gauges of PRs 7-9
+                      drifted from the docs exactly this way.
 
 All rules accept an explicit root so the fixture corpus can point them
 at deliberately broken trees.
@@ -267,6 +275,75 @@ def _check_parameter_registry(root: Path, readme: Path | None,
     return findings
 
 
+#: metric-series emission idioms: a direct string literal (or f-string
+#: family) right after .observe(/.count(/.gauge( -- matched over the
+#: full text so black's line wrapping cannot hide a name.  Telemetry
+#: deliberately keeps every emission name a DIRECT literal at the call
+#: site (see PipelineTelemetry._exit) so this collection is complete.
+_METRIC_EMITS = re.compile(
+    r'\.(?:observe|count|gauge)\(\s*(f?)"([a-z_0-9{}]+)"', re.S)
+#: README metrics-table rows inside the fenced region: | `name` | ...
+_METRIC_REGION = re.compile(
+    r"<!--\s*metrics-table\s*-->(.*?)<!--\s*/metrics-table\s*-->", re.S)
+_METRIC_ROW = re.compile(r"^\|\s*`([a-z_0-9]+)`", re.M)
+
+
+def _check_metric_registry(root: Path, readme: Path | None) -> list:
+    """Every emitted series name must be a row of the README metrics
+    table, and every row must still be emitted.  f-string families
+    (``f"frame_{bucket}_ms"``) are wildcards: they must match at least
+    one row, and a row matching a family counts as emitted."""
+    findings = []
+    literals: dict[str, str] = {}
+    families: dict[str, str] = {}
+    for path, text in _sources(root):
+        if "analysis" in path.parts or path.name.startswith("test"):
+            continue
+        for match in _METRIC_EMITS.finditer(text):
+            prefixed, name = match.group(1), match.group(2)
+            line = text.count("\n", 0, match.start()) + 1
+            where = f"{path.relative_to(root)}:{line}"
+            if prefixed and "{" in name:
+                families.setdefault(name, where)
+            elif "{" not in name:
+                literals.setdefault(name, where)
+    family_patterns = {
+        name: re.compile(
+            "^" + re.sub(r"\\\{[^}]*\\\}", "[a-z0-9_]+",
+                         re.escape(name)) + "$")
+        for name in families}
+    readme_text = readme.read_text() if readme and readme.is_file() \
+        else ""
+    region = _METRIC_REGION.search(readme_text)
+    documented = set(_METRIC_ROW.findall(region.group(1))) if region \
+        else set()
+    for name, where in sorted(literals.items()):
+        if name not in documented:
+            findings.append(Finding(
+                "metric-registry",
+                f"metric series {name!r} is emitted but not a row of "
+                f"the README metrics table "
+                f"(<!-- metrics-table --> region)", where))
+    for name, where in sorted(families.items()):
+        pattern = family_patterns[name]
+        if not any(pattern.match(row) for row in documented):
+            findings.append(Finding(
+                "metric-registry",
+                f"metric family {name!r} is emitted but no README "
+                f"metrics-table row matches it", where))
+    for row in sorted(documented):
+        if row in literals:
+            continue
+        if any(pattern.match(row)
+               for pattern in family_patterns.values()):
+            continue
+        findings.append(Finding(
+            "metric-registry",
+            f"README metrics table documents {row!r}, which nothing "
+            f"emits", "README.md"))
+    return findings
+
+
 def analyze_framework(package_root: Path | str | None = None,
                       readme: Path | str | None = None,
                       registry: dict | None = None) -> list:
@@ -283,4 +360,5 @@ def analyze_framework(package_root: Path | str | None = None,
     findings.extend(_check_spans(root))
     findings.extend(_check_resume_identity(root))
     findings.extend(_check_parameter_registry(root, readme, registry))
+    findings.extend(_check_metric_registry(root, readme))
     return findings

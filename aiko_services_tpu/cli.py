@@ -371,6 +371,173 @@ def lint(paths, self_check, strict, list_rules):
                       echo=click.echo))
 
 
+# -- critical-path explain (offline) ----------------------------------------
+
+@main.command("explain")
+@click.argument("path")
+@click.option("--frame", "frame_id", type=int, default=None,
+              help="restrict the timeline to ONE frame id (default: "
+                   "the dump's trigger frame, or everything)")
+@click.option("--stream", "stream_id", default=None,
+              help="with --frame: the frame's stream id")
+def explain(path, frame_id, stream_id):
+    """Render a black-box dump or a saved trace offline: the causal
+    timeline plus the critical-path bucket table (where did the
+    frame's time go).
+
+    PATH is a ``blackbox_*.json`` dump (written under the pipeline's
+    ``blackbox_dir`` on deadline miss / replay / breaker open /
+    replica failover / stream error), a single trace from
+    ``GET /traces/<id>``, or a ``GET /traces`` / ``GET /explain``
+    body saved to disk.  jax-free -- runs anywhere the dump landed.
+    """
+    from .observability import render_buckets, render_timeline
+    from .observability.critical_path import attribute_events
+
+    try:
+        payload = json.loads(open(path).read())
+    except (OSError, ValueError) as error:
+        raise click.ClickException(f"cannot read {path}: {error}")
+
+    if isinstance(payload, dict) \
+            and isinstance(payload.get("events"), list):
+        # Black-box dump: ring tail + in-flight frame states.  The
+        # list check discriminates against a saved /explain?frame=
+        # body, whose "events" key is an int COUNT, not the ring.
+        click.echo(f"black box: {payload.get('reason', '?')} in "
+                   f"pipeline {payload.get('pipeline', '?')} "
+                   f"(stream {payload.get('stream')}, frame "
+                   f"{payload.get('frame')})")
+        if payload.get("detail"):
+            click.echo(f"  {payload['detail']}")
+        target = frame_id if frame_id is not None \
+            else payload.get("frame")
+        target_stream = stream_id if stream_id is not None \
+            else payload.get("stream")
+        raw = payload["events"]
+        if target is not None:
+            from .observability import select_frame_events
+            known = {"t", "type", "stream", "frame", "name", "ms"}
+            events = [(entry.get("t", 0.0), entry.get("type", "?"),
+                       entry.get("stream"), entry.get("frame"),
+                       entry.get("name"), entry.get("ms"),
+                       {key: value for key, value in entry.items()
+                        if key not in known} or None)
+                      for entry in raw]
+            # Same stale-same-id discipline as the live engine: the
+            # dump's ring tail can span a destroyed stream AND its
+            # recreated same-id successor -- only the newest
+            # incarnation's frame events form one causal timeline.
+            events = select_frame_events(events, target, target_stream)
+            click.echo(f"\ntimeline for frame {target} "
+                       f"({len(events)} event(s)):")
+            report = attribute_events(events)
+            for line in render_timeline(report["timeline"]):
+                click.echo("  " + line)
+            click.echo("\nattribution:")
+            for line in render_buckets(report):
+                click.echo("  " + line)
+        else:
+            # No trigger frame (e.g. a replica_failover dump): the
+            # ring tail interleaves MANY frames, and the single-frame
+            # state machine would bill one frame's waits to another's
+            # compute -- render the raw interleaved timeline instead
+            # (shared renderer, each line tagged with its frame) and
+            # point at --frame for per-frame attribution.  The dump's
+            # entries are already ``events_as_dicts`` output: reshape
+            # in place, no tuple round trip.
+            click.echo(f"\ninterleaved timeline "
+                       f"({len(raw)} event(s)):")
+            base = raw[0].get("t", 0.0) if raw else 0.0
+            timeline = []
+            for entry in raw:
+                line_entry = dict(entry)
+                line_entry["t_ms"] = round(
+                    (line_entry.pop("t", 0.0) - base) * 1000.0, 3)
+                frame = line_entry.pop("frame", None)
+                stream = line_entry.pop("stream", None)
+                if frame is not None:
+                    line_entry["at"] = f"{stream}/{frame}"
+                timeline.append(line_entry)
+            for line in render_timeline(timeline):
+                click.echo("  " + line)
+            frames_seen = sorted(
+                {(str(entry.get("stream")), entry.get("frame"))
+                 for entry in raw if entry.get("frame") is not None})
+            if frames_seen:
+                click.echo(
+                    "\nper-frame attribution: re-run with --frame N "
+                    "[--stream S]; frames on this timeline: "
+                    + ", ".join(f"{s}/{f}" for s, f in frames_seen))
+        frames = payload.get("frames") or []
+        if frames:
+            click.echo(f"\nin-flight frames at dump time "
+                       f"({len(frames)}):")
+            for state in frames:
+                where = state.get("paused") or state.get("waiting") \
+                    or state.get("stage") or "walking"
+                click.echo(f"  stream {state.get('stream')} frame "
+                           f"{state.get('frame')}: at {where}, "
+                           f"replays={state.get('replays', 0)}, "
+                           f"age={state.get('age_s', 0)}s")
+        return
+
+    if isinstance(payload, dict) \
+            and isinstance(payload.get("timeline"), list):
+        # Saved /explain?frame= body (its "events" key is a COUNT).
+        click.echo(f"frame {payload.get('frame')} "
+                   f"(stream {payload.get('stream')}):")
+        for line in render_timeline(payload["timeline"]):
+            click.echo("  " + line)
+        if payload.get("buckets"):
+            click.echo("\nattribution:")
+            for line in render_buckets(payload):
+                click.echo("  " + line)
+        return
+
+    # Trace shapes: one trace, a /traces body, or an /explain report.
+    traces = []
+    if isinstance(payload, dict) and "spans" in payload:
+        traces = [payload]
+    elif isinstance(payload, dict) and "traces" in payload:
+        traces = payload["traces"]
+    if traces:
+        if frame_id is not None:
+            traces = [t for t in traces
+                      if any(s.get("frame") == frame_id
+                             for s in t.get("spans", []))]
+        for trace in traces:
+            click.echo(f"trace {trace.get('trace_id')} "
+                       f"({'ok' if trace.get('okay') else 'ERROR'}):")
+            spans = sorted(trace.get("spans", []),
+                           key=lambda s: s.get("start", 0.0))
+            base = spans[0].get("start", 0.0) if spans else 0.0
+            for span in spans:
+                offset = (span.get("start", 0.0) - base) * 1000.0
+                click.echo(f"  +{offset:10.3f} ms  "
+                           f"{span.get('kind', '?'):8} "
+                           f"{span.get('name', '?'):28} "
+                           f"{span.get('duration_ms', 0.0):10.3f} ms  "
+                           f"{span.get('status', '')}")
+            if trace.get("buckets"):
+                click.echo("  attribution:")
+                for line in render_buckets(trace):
+                    click.echo("    " + line)
+        return
+    if isinstance(payload, dict) and "buckets" in payload:
+        click.echo(f"aggregate over {payload.get('frames', '?')} "
+                   f"frame(s):")
+        for line in render_buckets(payload):
+            click.echo("  " + line)
+        for entry in payload.get("top", []):
+            click.echo(f"  top: {entry.get('stage')}:"
+                       f"{entry.get('bucket')} {entry.get('ms')} ms")
+        return
+    raise click.ClickException(
+        "unrecognized payload: expected a blackbox_*.json dump, a "
+        "trace, a /traces body, or an /explain report")
+
+
 # -- weight conversion ------------------------------------------------------
 
 @main.group()

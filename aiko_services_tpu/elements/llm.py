@@ -358,7 +358,20 @@ class LLM(PipelineElement):
             kv_pages=None if kv_pages is None else int(kv_pages),
             fetch=None if ledger is None
             else (lambda tree: ledger.fetch(tree, label="llm_block")),
-            fault_probe=self._fault_probe)
+            fault_probe=self._fault_probe,
+            on_block=self._note_block)
+
+    def _note_block(self, phase: str, slots: int) -> None:
+        """Flight-recorder tap (ISSUE 10): every decode-block dispatch/
+        retire lands on the pipeline's event ring (global events --
+        no stream/frame: one block serves many), so serving cadence is
+        on the same timeline as the frames in a black-box dump.  Runs
+        on the element's decode worker thread; the ring is
+        thread-safe and a missing recorder costs one getattr."""
+        recorder = getattr(self.pipeline, "recorder", None)
+        if recorder is not None:
+            recorder.record("llm_block", None, None, phase,
+                            None, {"slots": slots})
 
     def _make_request(self, stream_id, text,
                       request_params: dict) -> tuple[Request, list[int]]:

@@ -159,7 +159,8 @@ class ContinuousBatcher:
                  spec_window: int = 32, kv_page_tokens: int = 0,
                  kv_pages: int | None = None,
                  fetch: Callable | None = None,
-                 fault_probe: Callable | None = None):
+                 fault_probe: Callable | None = None,
+                 on_block: Callable | None = None):
         self.params = params
         self.config = config
         self.max_slots = max_slots
@@ -243,6 +244,12 @@ class ContinuousBatcher:
         # Armed-chaos probe called before every device-loop block
         # dispatch (the ``decode_block`` injection point); None = cold.
         self._fault_probe = fault_probe
+        # Flight-recorder tap (ISSUE 10): ``on_block("dispatch" |
+        # "retire", occupied_slots)`` fires at every fused/loop block
+        # boundary.  The LLM element wires it to the pipeline's
+        # recorder so serving cadence shows up on the same timeline as
+        # the frames it serves; None (the default) costs one branch.
+        self.on_block = on_block
         self.lengths = np.zeros(max_slots, dtype=np.int32)
         self.current = np.zeros(max_slots, dtype=np.int32)
         self.temperatures = np.zeros(max_slots, dtype=np.float32)
@@ -615,6 +622,8 @@ class ContinuousBatcher:
         self._inflight.append(_InflightBlock(
             emitted, [(i, self.slots[i]) for i in decoding], firsts,
             self.decode_block))
+        if self.on_block is not None:
+            self.on_block("dispatch", len(decoding))
 
     def _retire_block(self):
         """Fetch the OLDEST in-flight block's tokens (the async copy
@@ -626,6 +635,8 @@ class ContinuousBatcher:
         blk = self._inflight.popleft()
         emitted = np.asarray(blk.emitted)       # [steps, B]
         self.steps += 1
+        if self.on_block is not None:
+            self.on_block("retire", len(blk.snapshot))
         if blk.firsts is not None:
             first_meta, firsts_dev = blk.firsts
             first_tokens = np.asarray(firsts_dev)    # one fetch for all
@@ -765,6 +776,8 @@ class ContinuousBatcher:
         self._loop_inflight.append(_LoopBlock(
             tree, [(i, self.slots[i]) for i in snapshot], firsts_meta))
         self.blocks_dispatched += 1
+        if self.on_block is not None:
+            self.on_block("dispatch", len(snapshot))
         return True
 
     def _retire_loop_block(self):
@@ -777,6 +790,8 @@ class ContinuousBatcher:
         EARLIER than it, so truncation here only ever discards
         overshoot."""
         blk = self._loop_inflight.popleft()
+        if self.on_block is not None:
+            self.on_block("retire", len(blk.snapshot))
         fetched = self._fetch(blk.tree)
         emitted = np.asarray(fetched["emitted"])
         counts = np.asarray(fetched["counts"])

@@ -5,8 +5,13 @@ A tiny stdlib HTTP server (daemon thread) serving:
 - ``GET /metrics`` -- the Prometheus-style text exposition
   (``Pipeline.metrics_text()``);
 - ``GET /traces`` -- recent completed traces from the
-  :class:`~.tracing.TraceBuffer` as JSON (``?n=`` bounds the count);
-- ``GET /traces/<trace_id>`` -- one reconstructed trace.
+  :class:`~.tracing.TraceBuffer` as JSON (``?limit=`` bounds the
+  count, default 50, max 1000; ``?n=`` is the legacy alias);
+- ``GET /traces/<trace_id>`` -- one reconstructed trace;
+- ``GET /explain`` -- the aggregate critical-path report
+  (``Pipeline.explain()``; ``?top=`` bounds the contributor list,
+  ``?frame=<id>[&stream=<id>]`` returns one frame's
+  ``explain_frame`` timeline instead).
 
 Wired from the CLI via ``--metrics-port`` (0 picks a free port; the
 bound port is echoed).  The handlers read only lock-protected telemetry
@@ -89,20 +94,47 @@ class MetricsServer:
                 payload = trace
             else:
                 query = parse_qs(parsed.query)
+                raw = query.get("limit", query.get("n", ["50"]))[0]
                 try:
-                    n = int(query.get("n", ["20"])[0])
+                    n = int(raw)
                 except ValueError:
-                    handler.send_error(400, "n must be an integer")
+                    handler.send_error(400, "limit must be an integer")
                     return
                 if n <= 0:        # list[-0:] would be EVERYTHING
-                    handler.send_error(400, "n must be positive")
+                    handler.send_error(400, "limit must be positive")
                     return
+                # Bounded body + snapshot-under-lock iteration: a
+                # scrape during heavy ingest never races the buffer
+                # and never returns an unbounded payload.
                 payload = {"traces": telemetry.traces.recent(
                     min(n, 1000))}
             self._reply(handler, json.dumps(payload).encode(),
                         "application/json")
             return
-        handler.send_error(404, "try /metrics or /traces")
+        if path == "/explain":
+            if telemetry is None:
+                handler.send_error(404, "telemetry disabled")
+                return
+            query = parse_qs(parsed.query)
+            try:
+                frame = query.get("frame")
+                if frame is not None:
+                    payload = self.pipeline.explain_frame(
+                        int(frame[0]),
+                        stream_id=query.get("stream", [None])[0])
+                    if payload is None:
+                        handler.send_error(404, "unknown frame")
+                        return
+                else:
+                    payload = self.pipeline.explain(
+                        top_k=min(int(query.get("top", ["5"])[0]), 50))
+            except ValueError:
+                handler.send_error(400, "frame/top must be integers")
+                return
+            self._reply(handler, json.dumps(payload).encode(),
+                        "application/json")
+            return
+        handler.send_error(404, "try /metrics, /traces or /explain")
 
     @staticmethod
     def _reply(handler, body: bytes, content_type: str) -> None:

@@ -269,14 +269,22 @@ class MetricsRegistry:
             label_text = _labels_text(labels)
             lines.append(f"{full}_sum{label_text} {total:.6g}")
             lines.append(f"{full}_count{label_text} {count}")
+        emitted: set[tuple] = set()
         for (name, labels), value in counters:
             full = prefix + name
             if full not in seen_types:
                 lines.append(f"# TYPE {full} counter")
                 seen_types.add(full)
+            emitted.add((full, labels))
             lines.append(f"{full}{_labels_text(labels)} {value:.6g}")
         for (name, labels), value in gauges:
             full = prefix + name
+            if (full, labels) in emitted:
+                # The same series exists as a counter (a gauge-refresh
+                # of a counted total): a second sample under one name
+                # would invalidate the whole scrape -- the counter is
+                # authoritative.
+                continue
             if full not in seen_types:
                 lines.append(f"# TYPE {full} gauge")
                 seen_types.add(full)

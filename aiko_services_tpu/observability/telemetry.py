@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import time
 
+from .critical_path import attribute_metrics
 from .metrics import HISTOGRAM_WINDOW_DEFAULT, MetricsRegistry
 from .tracing import TRACE_CAPACITY_DEFAULT, TraceBuffer, make_span, \
     mint_id
@@ -132,6 +133,17 @@ class PipelineTelemetry:
         registry.observe("frame_latency_ms", elapsed_ms)
         registry.count("frames_total",
                        status="ok" if okay else "error")
+        # Critical-path attribution (ISSUE 10): split the frame's e2e
+        # latency into named buckets from the engine's own metric
+        # stamps -- fed to the frame_<bucket>_ms histograms here and
+        # attached to the trace entry below so ``Pipeline.explain()``
+        # aggregates without re-deriving.  Frames forwarded FROM
+        # another process carry no walk-start stamp of their own e2e;
+        # attribute against the measured elapsed either way.
+        attribution = attribute_metrics(frame.metrics, elapsed_ms)
+        for bucket, bucket_ms in attribution["buckets"].items():
+            if bucket_ms > 0.0:
+                registry.observe(f"frame_{bucket}_ms", bucket_ms)
         if frame.metrics.get("remote_retries"):
             registry.count("remote_stage_retries",
                            frame.metrics["remote_retries"])
@@ -150,7 +162,8 @@ class PipelineTelemetry:
                 stream_id, frame.frame_id, frame.trace_start,
                 elapsed_ms or (now - frame.trace_start) * 1000.0,
                 status="ok" if okay else "error"))
-            self.traces.add(frame.trace_id, frame.spans, okay)
+            self.traces.add(frame.trace_id, frame.spans, okay,
+                            attribution=attribution)
         self.publish()
 
     # -- hook handlers (always on the loop) --------------------------------
@@ -169,13 +182,14 @@ class PipelineTelemetry:
             return None
         return stream.frames.get(variables.get("frame"))
 
-    def _exit(self, kind: str, name, variables, histogram: str,
+    def _exit(self, kind: str, name, variables, elapsed_ms: float,
               **labels) -> None:
+        """Close an open span (the caller already observed the series
+        -- emission names stay DIRECT literals at .observe sites so the
+        ``metric-registry`` selfcheck can collect them statically)."""
         key = (kind, name, str(variables.get("stream")),
                variables.get("frame"))
         opened = self._open.pop(key, None)
-        elapsed_ms = float(variables.get("time", 0.0)) * 1000.0
-        self.registry.observe(histogram, elapsed_ms, **labels)
         event = variables.get("event")
         if _is_error(event):
             self.registry.count("element_errors_total", **labels)
@@ -228,9 +242,12 @@ class PipelineTelemetry:
                          variables.get("frame")))
 
     def _on_element_post(self, component, hook, variables):
-        self._exit("element", variables.get("element"), variables,
-                   "element_latency_ms",
-                   element=variables.get("element"))
+        name = variables.get("element")
+        elapsed_ms = float(variables.get("time", 0.0)) * 1000.0
+        self.registry.observe("element_latency_ms", elapsed_ms,
+                              element=name)
+        self._exit("element", name, variables, elapsed_ms,
+                   element=name)
 
     def _on_segment(self, component, hook, variables):
         self._note_open(("segment", variables.get("segment"),
@@ -241,9 +258,12 @@ class PipelineTelemetry:
                                 segment=variables.get("segment"))
 
     def _on_segment_post(self, component, hook, variables):
-        self._exit("segment", variables.get("segment"), variables,
-                   "segment_latency_ms",
-                   segment=variables.get("segment"))
+        name = variables.get("segment")
+        elapsed_ms = float(variables.get("time", 0.0)) * 1000.0
+        self.registry.observe("segment_latency_ms", elapsed_ms,
+                              segment=name)
+        self._exit("segment", name, variables, elapsed_ms,
+                   segment=name)
 
     def _on_stage(self, component, hook, variables):
         self._note_open(("stage", variables.get("stage"),
@@ -252,11 +272,12 @@ class PipelineTelemetry:
 
     def _on_stage_post(self, component, hook, variables):
         # The engine passes the measured residency (admit -> release).
-        variables = dict(variables)
-        variables.setdefault("time", float(
-            variables.get("ms", 0.0)) / 1000.0)
-        self._exit("stage", variables.get("stage"), variables,
-                   "stage_latency_ms", stage=variables.get("stage"))
+        name = variables.get("stage")
+        elapsed_ms = float(variables.get(
+            "time", float(variables.get("ms", 0.0)) / 1000.0)) * 1000.0
+        self.registry.observe("stage_latency_ms", elapsed_ms,
+                              stage=name)
+        self._exit("stage", name, variables, elapsed_ms, stage=name)
 
     def _on_stage_hop(self, component, hook, variables):
         hop_ms = float(variables.get("ms", 0.0))
@@ -290,6 +311,12 @@ class PipelineTelemetry:
                 # telemetry.llm.* next to the llm_accepted_tokens /
                 # llm_draft_tokens counters below.
                 result.setdefault("llm", {})[name[4:]] = brief
+                continue
+            if name.startswith("frame_") and name.endswith("_ms") \
+                    and name != "frame_latency_ms":
+                # Critical-path buckets (ISSUE 10): telemetry.buckets.*
+                # on the dashboard -- the live "where is time going".
+                result.setdefault("buckets", {})[name[6:-3]] = brief
                 continue
             if name == "frame_latency_ms":
                 result["frame"] = brief
@@ -348,12 +375,12 @@ class PipelineTelemetry:
         ledger = pipeline.transfer_ledger
         registry.gauge("swag_host_transfers", ledger.implicit)
         registry.gauge("swag_explicit_fetches", ledger.explicit)
-        # Failure-recovery plane (ISSUE 5): replay/shed/deadline
-        # counters and per-remote-stage breaker state (0 closed,
-        # 0.5 half-open, 1 open) -- the scrape-side proof that recovery
-        # ran, mirroring the chaos suite's assertions.
-        for key in ("frames_replayed", "frames_shed", "deadline_misses"):
-            registry.gauge(key, pipeline.share.get(key, 0))
+        # Failure-recovery plane (ISSUE 5): per-remote-stage breaker
+        # state (0 closed, 0.5 half-open, 1 open).  The replay/shed/
+        # deadline totals are COUNTERS fed at the transition sites --
+        # refreshing them as gauges too would emit the same sample
+        # name twice and invalidate the whole scrape (the PR 9
+        # data_plane_fallbacks lesson).
         for stage, breaker in getattr(pipeline, "breakers", {}).items():
             registry.gauge("breaker_state", breaker.state_value,
                            stage=stage)
@@ -404,6 +431,13 @@ class PipelineTelemetry:
                                stats.get("fallbacks", 0))
                 registry.gauge("tensor_pipe_dropped_frames",
                                stats.get("dropped_frames", 0))
+        # Flight recorder (ISSUE 10): ring depth + lifetime event count
+        # -- a scrape-side signal the always-on recorder is recording
+        # (and how far back a black-box dump's tail can reach).
+        recorder = getattr(pipeline, "recorder", None)
+        if recorder is not None:
+            registry.gauge("recorder_events", recorder.recorded)
+            registry.gauge("recorder_buffered", len(recorder))
         registry.gauge("traces_buffered", len(self.traces))
         registry.gauge("traces_completed", self.traces.completed)
         return registry.render_text()

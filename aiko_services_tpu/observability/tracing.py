@@ -94,8 +94,12 @@ class TraceBuffer:
         self._lock = threading.Lock()
         self.completed = 0
 
-    def add(self, trace_id: str, spans: list[dict],
-            okay: bool = True) -> None:
+    def add(self, trace_id: str, spans: list[dict], okay: bool = True,
+            attribution: dict | None = None) -> None:
+        """``attribution`` (ISSUE 10) is the frame's critical-path
+        bucket split from ``critical_path.attribute_metrics``: its
+        buckets/stages/e2e land on the trace entry so ``explain()``
+        and the ``/explain`` route aggregate without re-deriving."""
         if not trace_id:
             return
         with self._lock:
@@ -108,6 +112,11 @@ class TraceBuffer:
             entry["spans"].extend(spans)
             entry["okay"] = entry["okay"] and bool(okay)
             entry["finished"] = time.time()
+            if attribution:
+                for key in ("buckets", "stages", "e2e_ms",
+                            "unattributed_ms", "coverage"):
+                    if attribution.get(key) is not None:
+                        entry[key] = attribution[key]
             self._traces.move_to_end(trace_id)
             while len(self._traces) > self.capacity:
                 self._traces.popitem(last=False)
@@ -121,6 +130,31 @@ class TraceBuffer:
         with self._lock:
             entries = list(self._traces.values())[-n:]
             return [_copy_trace(entry) for entry in entries]
+
+    def snapshot(self) -> list[dict]:
+        """Every buffered trace, copied under the lock (oldest first)
+        -- the iteration surface ``explain()``/scrapes use; iterating
+        the live OrderedDict from another thread would race adds."""
+        with self._lock:
+            return [_copy_trace(entry)
+                    for entry in self._traces.values()]
+
+    def by_frame(self, frame_id, stream=None) -> dict | None:
+        """The NEWEST trace containing a span for ``frame_id`` (and
+        ``stream`` when given) -- the explain_frame lookup."""
+        frame_id = int(frame_id)
+        stream = None if stream is None else str(stream)
+        with self._lock:
+            for entry in reversed(self._traces.values()):
+                for span in entry["spans"]:
+                    try:
+                        match = int(span.get("frame")) == frame_id
+                    except (TypeError, ValueError):
+                        continue
+                    if match and (stream is None
+                                  or str(span.get("stream")) == stream):
+                        return _copy_trace(entry)
+        return None
 
     def __len__(self) -> int:
         with self._lock:

@@ -43,11 +43,15 @@ from .stages import (STAGE_INFLIGHT_DEFAULT, STAGE_PIPELINE_MODES,
                      StageScheduler)
 from .stream import (Stream, Frame, StreamEvent, StreamState,
                      DEFAULT_STREAM_ID)
-from ..observability import (HISTOGRAM_WINDOW_DEFAULT,
+from ..observability import (BLACKBOX_LIMIT_DEFAULT,
+                             HISTOGRAM_WINDOW_DEFAULT,
+                             RECORDER_CAPACITY_DEFAULT,
                              TELEMETRY_INTERVAL_DEFAULT,
-                             TRACE_CAPACITY_DEFAULT, PipelineTelemetry,
-                             decode_spans, encode_spans, make_span,
-                             mint_id)
+                             TRACE_CAPACITY_DEFAULT, FlightRecorder,
+                             PipelineTelemetry, aggregate_traces,
+                             attribute_events, decode_spans,
+                             encode_spans, events_as_dicts, make_span,
+                             mint_id, write_blackbox)
 from ..analysis.lint import preflight as preflight_check
 from ..faults import (CircuitBreaker, FaultInjected, FaultPlan,
                       wire_fault_filter)
@@ -99,6 +103,10 @@ OVERLOAD_LIMIT_DEFAULT = 8
 REPLICA_REBUILD_MS_DEFAULT = 200.0
 REPLICA_SCALE_UP_OCCUPANCY = 0.75
 REPLICA_SCALE_DOWN_OCCUPANCY = 0.25
+# Black-box dumps (ISSUE 10) are debounced per reason: a sustained
+# failure episode (every frame missing its deadline) writes one dump
+# per window, not one per frame on the event loop.
+_BLACKBOX_COOLDOWN_S = 5.0
 
 # Stage-worker threads (pipeline/stages.py) run elements off the event
 # loop; ``get_parameter`` resolution reaches the owning stream through
@@ -298,6 +306,38 @@ class Pipeline(Actor):
                 publish_interval=float(parse_number(
                     definition.parameters.get("telemetry_interval"),
                     TELEMETRY_INTERVAL_DEFAULT)))
+
+        # Flight recorder + black-box (ISSUE 10): an always-on bounded
+        # ring of typed engine events behind every seam below
+        # (``recorder: off`` -> None, and every emission site is an
+        # ``is not None`` no-op -- the unarmed-FaultPlan discipline).
+        # ``blackbox_dir`` arms crash-dump snapshots: deadline miss,
+        # replay, breaker open, replica failover and stream errors
+        # write the ring tail + in-flight frame states (redacted --
+        # ids/names/numbers only) to bounded JSON files that
+        # ``python -m aiko_services_tpu explain <dump>`` renders.
+        recorder_mode = str(definition.parameters.get(
+            "recorder", "on")).strip().lower()
+        if recorder_mode in ("off", "false", "0"):
+            self.recorder = None
+        else:
+            self.recorder = FlightRecorder(int(parse_number(
+                definition.parameters.get("recorder_capacity"),
+                RECORDER_CAPACITY_DEFAULT)))
+        self._blackbox_dir = definition.parameters.get(
+            "blackbox_dir") or None
+        if self._blackbox_dir is not None and self.recorder is None:
+            # Dumps ARE ring snapshots: without the recorder the
+            # configuration is dead -- say so at create, not at the
+            # crash the operator configured dumps to explain.
+            _logger.warning("blackbox_dir is set but recorder=off: "
+                            "no black-box dumps will be written")
+        self._blackbox_limit = int(parse_number(
+            definition.parameters.get("blackbox_limit"),
+            BLACKBOX_LIMIT_DEFAULT))
+        self.share["blackbox_dumps"] = 0
+        self._blackbox_dumps = 0
+        self._blackbox_last: dict[str, float] = {}
 
         self._health_timer = None
         interval = self.definition.parameters.get("health_check_interval")
@@ -602,6 +642,10 @@ class Pipeline(Actor):
         # surviving slot re-admits live -- the canary discipline is for
         # the targeted rebuild path, not the stop-the-world one.
         self._reset_replica_groups()
+        self._rec("replace", ms=None,
+                  info={"failed": len(failed_set),
+                        "generation": placement.generation,
+                        "replayed": replayed})
         self.run_hook("pipeline.replacement:0",
                       lambda: {"failed": [str(d) for d in failed_devices],
                                "generation": placement.generation,
@@ -703,6 +747,11 @@ class Pipeline(Actor):
         if self.telemetry is not None:
             self.telemetry.registry.count("replica_failovers",
                                           stage=stage)
+        self._rec("failover", name=stage, ms=failover_ms,
+                  info={"replica": index, "chips": len(dead),
+                        "replayed": replayed})
+        self._blackbox("replica_failover", detail=f"{stage}#{index}: "
+                       f"{len(dead)} chip(s), {replayed} replayed")
         self.run_hook("pipeline.replica_failover:0",
                       lambda: {"stage": stage, "replica": index,
                                "failed": [str(d) for d in dead],
@@ -1115,6 +1164,8 @@ class Pipeline(Actor):
         # Exposition rides the metrics_text gauge refresh (like
         # data_plane_frames) -- registering the same name as a counter
         # TOO would emit duplicate samples and invalidate the scrape.
+        self._rec("pipe_fallback", name=where,
+                  info={"reason": reason})
         mark = (where, reason)
         if mark not in self._pipe_fallback_logged:
             self._pipe_fallback_logged.add(mark)
@@ -1154,6 +1205,8 @@ class Pipeline(Actor):
 
     def _count_claim_dropped(self, token, command: str) -> None:
         self._plane_counts["claims_dropped"] += 1
+        self._rec("claim_drop", name=str(token),
+                  info={"command": command})
         self.logger.warning(
             "data plane: %s token %s expired with tensors missing -- "
             "dropping the envelope (sender recovers via deadline/"
@@ -1533,6 +1586,23 @@ class Pipeline(Actor):
                     f"replay limit ({replay_limit}) exceeded after "
                     f"device replacement")
                 return False
+        # Critical-path ``replay`` bucket: time since the frame last
+        # made progress (the end of its most recently FINISHED element
+        # run, or the start of the one still in flight) -- the work
+        # this replay voids.  Completed runs stay billed to
+        # ``compute``; the wall time covers both attempts, so buckets
+        # still sum to e2e, not above it.
+        progress = []
+        for key, value in frame.metrics.items():
+            if key.endswith("_time_start"):
+                elapsed = frame.metrics.get(f"{key[:-11]}_time")
+                progress.append(float(value)
+                                + float(elapsed or 0.0))
+        if progress:
+            lost_ms = (time.perf_counter() - max(progress)) * 1000.0
+            if lost_ms > 0.0:
+                frame.metrics["replay_lost_ms"] = \
+                    frame.metrics.get("replay_lost_ms", 0.0) + lost_ms
         # Stale-ify every in-flight continuation of the PREVIOUS
         # attempt: worker/async completion posts carry the epoch they
         # were submitted under and are discarded on mismatch.
@@ -1551,6 +1621,16 @@ class Pipeline(Actor):
                 break
         self._count_replay(stream)
         frame.metrics["replays"] = frame.replays
+        self._rec("replay", stream.stream_id, frame.frame_id,
+                  resume_at, info={"attempt": frame.replays,
+                                   "counted": count})
+        if count:
+            # Administrative replays (autoscale re-split, background
+            # rebuild) touch every in-flight frame -- only genuine
+            # failure replays are worth a dump each.
+            self._blackbox("replay", stream.stream_id, frame.frame_id,
+                           detail=f"resume at {resume_at} "
+                                  f"(attempt {frame.replays})")
         self.logger.warning(
             "stream %s frame %s: replaying at %s (attempt %d) after "
             "device replacement", stream.stream_id, frame.frame_id,
@@ -1615,7 +1695,15 @@ class Pipeline(Actor):
             if isinstance(node.element, RemoteStage):
                 breaker = self._stage_breaker(parked_at)
                 if breaker is not None:
-                    breaker.record_failure()
+                    self._breaker_failure(parked_at, breaker,
+                                          stream.stream_id,
+                                          frame.frame_id)
+        self._rec("deadline", stream.stream_id, frame.frame_id,
+                  parked_at)
+        self._blackbox("deadline_miss", stream.stream_id,
+                       frame.frame_id,
+                       detail=f"parked at {parked_at}"
+                       if parked_at else "")
         frame.metrics["deadline_missed"] = True
         frame.replay_epoch += 1         # stale-ify late worker posts
         self._frame_fail(stream, frame,
@@ -1660,6 +1748,8 @@ class Pipeline(Actor):
             if victim is not None:
                 self._count_shed(stream)
                 victim.metrics["shed"] = True
+                self._rec("shed", stream.stream_id, victim.frame_id,
+                          info={"policy": stream.overload_policy})
                 self._frame_fail(
                     stream, victim,
                     f"shed: overload ({stream.overload_policy}, "
@@ -1673,6 +1763,9 @@ class Pipeline(Actor):
         error immediately."""
         self._count_shed(stream)
         frame.metrics["shed"] = True
+        self._rec("shed", stream.stream_id, frame.frame_id,
+                  info={"policy": stream.overload_policy,
+                        "incoming": True})
         self._frame_fail(stream, frame,
                          f"shed: overload ({stream.overload_policy}, "
                          f"{stream.in_flight} in flight)")
@@ -1728,7 +1821,8 @@ class Pipeline(Actor):
             element = self._fallback_elements[node.name] = cls(context)
         inputs, missing, _ = self._map_in_for(element,
                                               node.properties or {},
-                                              frame.swag)
+                                              frame.swag, frame=frame,
+                                              stream=stream)
         if missing:
             self._frame_error(stream, frame,
                               f"{fallback_name} (fallback for "
@@ -1777,6 +1871,165 @@ class Pipeline(Actor):
         if self.telemetry is None:
             return None
         return self.telemetry.traces.get(str(trace_id))
+
+    # -- flight recorder + critical path (ISSUE 10) ------------------------
+
+    def _rec(self, etype: str, stream=None, frame=None, name=None,
+             ms=None, info=None) -> None:
+        """One guarded flight-recorder append (no-op under
+        ``recorder: off``).  Sites may only pass ids/names/numbers --
+        the black-box dump's redaction rests on it."""
+        recorder = self.recorder
+        if recorder is not None:
+            recorder.record(etype, stream, frame, name, ms, info)
+
+    def explain(self, top_k: int = 5) -> dict:
+        """Aggregate critical-path report over the trace buffer: bucket
+        totals (compute / queue / hop / fetch / pipe / replay /
+        pacing), per-stage/replica splits, and the top-k (stage,
+        bucket) contributors -- the "where did the time go" answer for
+        recent traffic.  Thread-safe (trace buffer snapshots under its
+        lock); empty when ``telemetry: off``."""
+        if self.telemetry is None:
+            return {}
+        report = aggregate_traces(self.telemetry.traces.snapshot(),
+                                  top_k=top_k)
+        report["pipeline"] = self.name
+        if self.recorder is not None:
+            report["recorder"] = self.recorder.stats
+        return report
+
+    def explain_frame(self, frame_id, stream_id=None) -> dict | None:
+        """One frame's causal story: the flight-recorder timeline (what
+        happened, in order, with every interval attributed to a
+        bucket) plus its trace spans and completion attribution.  Works
+        for in-flight frames too (partial timeline); None when neither
+        the ring nor the trace buffer knows the frame.  Thread-safe.
+
+        Frame ids restart per stream (and per stream INCARNATION):
+        with ``stream_id`` omitted the NEWEST stream holding that
+        frame id wins, and within a stream only the newest incarnation
+        segment is used (``FlightRecorder.frame_events``) -- never a
+        merge of same-id frames, which would attribute one frame's
+        waits to another's compute and terminate the timeline at the
+        wrong ``done``."""
+        events = []
+        if self.recorder is not None:
+            if stream_id is None:
+                candidates = self.recorder.snapshot(frame=frame_id)
+                if candidates:
+                    stream_id = candidates[-1][2]
+            if stream_id is not None:
+                events = self.recorder.frame_events(stream_id,
+                                                    frame_id)
+        trace = None if self.telemetry is None else \
+            self.telemetry.traces.by_frame(frame_id, stream=stream_id)
+        if not events and trace is None:
+            return None
+        result: dict = {"frame": int(frame_id),
+                        "stream": None if stream_id is None
+                        else str(stream_id)}
+        if events:
+            result.update(attribute_events(events))
+        if trace is not None:
+            result["trace_id"] = trace["trace_id"]
+            result["okay"] = trace["okay"]
+            result["spans"] = trace["spans"]
+            if not events:
+                # Ring already wrapped past this frame: fall back to
+                # the completion-time attribution on the trace entry.
+                for key in ("buckets", "stages", "e2e_ms",
+                            "unattributed_ms", "coverage"):
+                    if trace.get(key) is not None:
+                        result[key] = trace[key]
+        return result
+
+    def _frame_states(self) -> list[dict]:
+        """Redacted in-flight frame states for the black-box dump:
+        position + numeric metrics + swag KEY names -- never values."""
+        states = []
+        for stream in self.streams.values():
+            for frame in stream.frames.values():
+                states.append({
+                    "stream": stream.stream_id,
+                    "frame": frame.frame_id,
+                    "paused": frame.paused_pe_name,
+                    "stage": frame.stage,
+                    "replica": frame.stage_replica,
+                    "waiting": frame.stage_waiting,
+                    "replays": frame.replays,
+                    "age_s": round(time.monotonic() - frame.created, 3),
+                    "swag_keys": sorted(str(key) for key in frame.swag),
+                    "metrics": {key: value for key, value
+                                in frame.metrics.items()
+                                if isinstance(value,
+                                              (int, float, bool, str))}})
+        return states
+
+    def _blackbox(self, reason: str, stream=None, frame=None,
+                  detail: str = "") -> None:
+        """Snapshot the flight-recorder tail + in-flight frame states
+        to a bounded JSON dump under ``blackbox_dir`` (off when the
+        parameter is unset or the recorder is off).  Runs on the event
+        loop at failure-transition sites, debounced per reason
+        (``_BLACKBOX_COOLDOWN_S``): a sustained episode -- every frame
+        of an overloaded stream missing its deadline -- must cost ONE
+        dump per window, not a serialize+glob on the latency-critical
+        loop per failure (the first dump's ring tail already holds the
+        episode; later near-identical snapshots would only evict it)."""
+        directory = self._blackbox_dir
+        if directory is None or self.recorder is None:
+            return
+        now = time.monotonic()
+        last = self._blackbox_last.get(reason)
+        if last is not None and now - last < _BLACKBOX_COOLDOWN_S:
+            return
+        try:
+            payload = {"reason": reason,
+                       "pipeline": self.name,
+                       "wall_time": time.time(),
+                       "stream": None if stream is None else str(stream),
+                       "frame": frame,
+                       "detail": str(detail)[:500],
+                       "generation": self.stage_placement.generation
+                       if self.stage_placement is not None else 0,
+                       "recorder": self.recorder.stats,
+                       "frames": self._frame_states(),
+                       "events": events_as_dicts(
+                           self.recorder.snapshot(tail=1024))}
+            path = write_blackbox(directory, payload,
+                                  limit=self._blackbox_limit)
+            # Charge the cooldown only on a SUCCESSFUL write: a full
+            # disk must not silently eat the whole episode's window.
+            self._blackbox_last[reason] = now
+            self._blackbox_dumps += 1
+            self.share["blackbox_dumps"] = self._blackbox_dumps
+            self.logger.warning("black-box dump (%s): %s", reason, path)
+        except Exception:
+            self.logger.exception("black-box dump failed (%s)", reason)
+
+    def _breaker_failure(self, name: str, breaker,
+                         stream=None, frame=None) -> None:
+        """Charge a remote stage's breaker, recording the transition --
+        an OPEN transition is a black-box trigger (the stage just went
+        dark; the ring tail holds the round trips that killed it)."""
+        was = breaker.state
+        breaker.record_failure()
+        now = breaker.state
+        if now != was:
+            self._rec("breaker", stream, frame, name,
+                      info={"state": now})
+            if now == "open":
+                self._blackbox("breaker_open", stream, frame,
+                               detail=f"stage {name}")
+
+    def _breaker_success(self, name: str, breaker,
+                         stream=None, frame=None) -> None:
+        was = breaker.state
+        breaker.record_success()
+        if breaker.state != was:
+            self._rec("breaker", stream, frame, name,
+                      info={"state": breaker.state})
 
     # -- stream lifecycle --------------------------------------------------
 
@@ -1980,6 +2233,11 @@ class Pipeline(Actor):
             # this dead incarnation must not leak onto a recreated
             # same-id stream's frames (ids restart per stream).
             self.telemetry.stream_destroyed(stream_id)
+        # Incarnation boundary on the flight-recorder ring: a recreated
+        # same-id stream's frame timelines must not merge with this
+        # dead incarnation's same-id frames (recorder.frame_events
+        # splits at this marker -- the ring itself is append-only).
+        self._rec("stream_end", stream_id)
         self.ec_producer.update("streams", len(self.streams))
 
     # -- frame ingestion ---------------------------------------------------
@@ -2024,6 +2282,7 @@ class Pipeline(Actor):
                       swag=dict(frame_data))
         if self.telemetry is not None:
             self.telemetry.frame_started(frame)
+        self._rec("ingest", stream.stream_id, frame.frame_id)
         shed = self._shed_for_overload(stream)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
@@ -2035,9 +2294,8 @@ class Pipeline(Actor):
         # enqueues, sync the oldest completed-but-unsynced frame(s) so
         # dispatch stays at most device_inflight frames ahead.
         paced = stream.device_window.pace(stream.device_inflight)
-        if paced and self.telemetry is not None:
-            self.telemetry.registry.observe("ingest_pace_ms",
-                                            paced * 1000.0)
+        if paced:
+            self._note_pace(stream, frame, paced)
         self._process_frame_common(stream, frame)
 
     def _ingest(self, stream_dict: dict, frame_data: dict):
@@ -2069,6 +2327,7 @@ class Pipeline(Actor):
             # the stream's reorder buffer / admission window.
             self._release_stage(stream, stale)
             self._deliver(stream, stale, okay=False, skip=True)
+        self._rec("ingest", stream.stream_id, frame.frame_id)
         shed = self._shed_for_overload(stream)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
@@ -2077,10 +2336,33 @@ class Pipeline(Actor):
             return
         self._stamp_deadline(stream, frame)
         paced = stream.device_window.pace(stream.device_inflight)
-        if paced and self.telemetry is not None:
-            self.telemetry.registry.observe("ingest_pace_ms",
-                                            paced * 1000.0)
+        if paced:
+            self._note_pace(stream, frame, paced)
         self._process_frame_common(stream, frame)
+
+    def _note_pace(self, stream: Stream, frame: Frame,
+                   paced: float) -> None:
+        """Ingest blocked on the dispatch window: stamp the frame (the
+        ``pacing`` critical-path bucket), the histogram and the ring."""
+        paced_ms = paced * 1000.0
+        frame.metrics["ingest_pace_ms"] = paced_ms
+        if self.telemetry is not None:
+            self.telemetry.registry.observe("ingest_pace_ms", paced_ms)
+        self._rec("pace", stream.stream_id, frame.frame_id,
+                  ms=paced_ms)
+
+    def _note_fetch(self, stream: Stream, frame: Frame, name: str,
+                    fetch_ms: float) -> None:
+        """An engine-initiated counted ledger fetch ran for ``frame``
+        on behalf of element ``name``: accumulate the ``fetch``
+        critical-path bucket (``<name>_fetch_ms``) and the ring event.
+        Loop-confined (every engine fetch site runs on the loop)."""
+        if fetch_ms <= 0.0:
+            return
+        key = f"{name}_fetch_ms"
+        frame.metrics[key] = frame.metrics.get(key, 0.0) + fetch_ms
+        self._rec("fetch", stream.stream_id, frame.frame_id, name,
+                  fetch_ms)
 
     def _assign_delivery_seq(self, stream: Stream, frame: Frame) -> None:
         """Under stage-parallel execution frames complete out of walk
@@ -2177,6 +2459,8 @@ class Pipeline(Actor):
                     # queued tokens from a destroyed same-id stream).
                     frame.stage_waiting = node.name
                     frame.stage_wait_start = time.perf_counter()
+                    self._rec("stage_wait", stream.stream_id,
+                              frame.frame_id, node.name)
                     self.post_self("enter_stage_frame",
                                    [stream.stream_id, frame.frame_id,
                                     node.name, False, frame])
@@ -2204,6 +2488,8 @@ class Pipeline(Actor):
                         if self.telemetry is not None:
                             self.telemetry.registry.count(
                                 "breaker_rejects", stage=node.name)
+                        self._rec("breaker_reject", stream.stream_id,
+                                  frame.frame_id, node.name)
                         self._frame_fail(
                             stream, frame,
                             f"remote stage {node.name}: circuit "
@@ -2257,7 +2543,9 @@ class Pipeline(Actor):
                                    [stream.stream_id, frame, node.name],
                                    delay=delay)
                     return
-                inputs, missing, host_typed = self._map_in(node, swag)
+                inputs, missing, host_typed = self._map_in(node, swag,
+                                                           frame=frame,
+                                                           stream=stream)
                 if missing:
                     self._frame_error(
                         stream, frame,
@@ -2281,6 +2569,8 @@ class Pipeline(Actor):
                         if frame.stage == node.name else None))
                     hop_ms = (time.perf_counter() - hop_start) * 1000.0
                     frame.metrics[f"{node.name}_hop_ms"] = hop_ms
+                    self._rec("hop", stream.stream_id, frame.frame_id,
+                              node.name, hop_ms)
                     self.run_hook("pipeline.stage_hop:0",
                                   lambda: {"stage": node.name,
                                            "stream": stream.stream_id,
@@ -2308,6 +2598,8 @@ class Pipeline(Actor):
                 # cannot show (or test) that k+1's first element began
                 # before k's last completed.
                 frame.metrics[f"{node.name}_time_start"] = start
+                self._rec("dispatch", stream.stream_id, frame.frame_id,
+                          node.name)
                 if _METRICS_MEMORY:
                     rss_before = process_memory_rss()
                 ledger = self.transfer_ledger
@@ -2328,6 +2620,10 @@ class Pipeline(Actor):
                     if ledger.is_guard_error(error):
                         ledger.record_implicit()
                     self.logger.exception("element %s raised", node.name)
+                    self._rec("dispatch_done", stream.stream_id,
+                              frame.frame_id, node.name,
+                              (time.perf_counter() - start) * 1000.0,
+                              {"status": "error"})
                     self._element_post_error(stream, frame, node.name,
                                              start)
                     if self._recover_after_dispatch_error(stream, frame):
@@ -2337,6 +2633,9 @@ class Pipeline(Actor):
                     return
                 frame.metrics[f"{node.name}_time"] = \
                     time.perf_counter() - start
+                self._rec("dispatch_done", stream.stream_id,
+                          frame.frame_id, node.name,
+                          frame.metrics[f"{node.name}_time"] * 1000.0)
                 if element.device_resident:
                     frame.metrics["device_dispatches"] = \
                         frame.metrics.get("device_dispatches", 0) + 1
@@ -2481,6 +2780,9 @@ class Pipeline(Actor):
                                    "compile": compiling,
                                    "time": time.perf_counter() - start})
 
+        self._rec("dispatch", stream.stream_id, frame.frame_id,
+                  segment.name, info={"kind": "segment",
+                                      "compile": compiling})
         try:
             if self._faults is not None:
                 self._inject_segment_fault(segment.name,
@@ -2497,6 +2799,10 @@ class Pipeline(Actor):
         except Exception as error:
             if ledger.is_guard_error(error):
                 ledger.record_implicit()
+            self._rec("dispatch_done", stream.stream_id,
+                      frame.frame_id, segment.name,
+                      (time.perf_counter() - start) * 1000.0,
+                      {"status": "error"})
             post_hook(StreamEvent.ERROR)
             if compiling:
                 # Build/trace failure on a fresh signature: the fused
@@ -2514,9 +2820,12 @@ class Pipeline(Actor):
                 return None     # chips died: frame replayed/bounded
             self._frame_error(stream, frame, f"{segment.name}: {error}")
             return None
+        elapsed = time.perf_counter() - start
+        self._rec("dispatch_done", stream.stream_id, frame.frame_id,
+                  segment.name, elapsed * 1000.0)
         return self._segment_finish(stream, frame, segment, out,
                                     resolved, donated, post_hook,
-                                    time.perf_counter() - start)
+                                    elapsed)
 
     def _segment_finish(self, stream: Stream, frame: Frame,
                         segment: FusedSegment, out: dict, resolved: dict,
@@ -2543,9 +2852,13 @@ class Pipeline(Actor):
                 if step.dfn.finalize is not None:
                     # The element's host postprocess: ONE counted fetch
                     # of its device slate at the segment boundary.
+                    fetch_start = time.perf_counter()
                     fetched = ledger.fetch(
                         {name: out[f"{step.node.name}.{name}"]
                          for name in step.dfn.finalize_inputs})
+                    self._note_fetch(
+                        stream, frame, step.node.name,
+                        (time.perf_counter() - fetch_start) * 1000.0)
                     outputs.update(step.dfn.finalize(fetched))
                 self._map_out(step.node, frame, outputs)
                 frame.metrics[f"{step.node.name}_time"] = 0.0
@@ -2638,6 +2951,9 @@ class Pipeline(Actor):
             self._release_stage(stream, frame)
             frame.stage = node_name
             frame.stage_replica = replica
+            self._rec("admit", stream.stream_id, frame.frame_id,
+                      node_name, info=None if replica is None
+                      else {"replica": replica})
             if replica is not None:
                 frame.metrics[f"stage_{node_name}_replica"] = replica
             frame.stage_generation = \
@@ -2718,6 +3034,9 @@ class Pipeline(Actor):
         if admit is not None:
             frame.metrics[f"stage_{stage}_ms"] = \
                 (time.perf_counter() - admit) * 1000.0
+        self._rec("release", stream.stream_id, frame.frame_id, stage,
+                  info=None if replica is None
+                  else {"replica": replica})
         self.run_hook("pipeline.process_stage_post:0",
                       lambda: {"stage": stage,
                                "stream": stream.stream_id,
@@ -2752,6 +3071,7 @@ class Pipeline(Actor):
         epoch = frame.replay_epoch      # stale after a replay
         submitted = time.perf_counter()
         frame.metrics[f"{node_name}_time_start"] = submitted
+        self._rec("submit", stream_id, frame_id, node_name)
         if element.device_resident:
             frame.metrics["device_dispatches"] = \
                 frame.metrics.get("device_dispatches", 0) + 1
@@ -2759,6 +3079,9 @@ class Pipeline(Actor):
 
         def job():
             start = time.perf_counter()
+            self._rec("dispatch", stream_id, frame_id, node_name,
+                      info=None if replica is None
+                      else {"replica": replica})
             _THREAD_STREAM.stream = stream
             # While this worker runs, ``self.plan`` on the stage's
             # elements IS the replica's submesh (tensor.TPUElement).
@@ -2785,10 +3108,14 @@ class Pipeline(Actor):
             finally:
                 _THREAD_STREAM.stream = None
                 _THREAD_STREAM.replica = None
+            elapsed = time.perf_counter() - start
+            self._rec("dispatch_done", stream_id, frame_id, node_name,
+                      elapsed * 1000.0,
+                      None if event != StreamEvent.ERROR
+                      else {"status": "error"})
             self.post_self("resume_stage_frame",
                            [stream_id, frame_id, node_name, event,
-                            outputs, start,
-                            time.perf_counter() - start, submitted,
+                            outputs, start, elapsed, submitted,
                             frame, epoch])
 
         self.stage_scheduler.executor(node_name, replica).submit(job)
@@ -2831,12 +3158,16 @@ class Pipeline(Actor):
         resolved, donated, _compiling, _submitted = begun
         frame.paused_pe_name = segment.name
         stream_id, frame_id = stream.stream_id, frame.frame_id
+        self._rec("submit", stream_id, frame_id, segment.name)
         replica = self._frame_replica_for(frame, segment)
         epoch = frame.replay_epoch      # stale after a replay
         ledger = self.transfer_ledger
 
         def job():
             start = time.perf_counter()
+            self._rec("dispatch", stream_id, frame_id, segment.name,
+                      info={"kind": "segment"} if replica is None
+                      else {"kind": "segment", "replica": replica})
             _THREAD_STREAM.stream = stream
             _THREAD_STREAM.replica = None if replica is None \
                 else (segment.stage_context, replica)
@@ -2867,11 +3198,14 @@ class Pipeline(Actor):
             finally:
                 _THREAD_STREAM.stream = None
                 _THREAD_STREAM.replica = None
+            elapsed = time.perf_counter() - start
+            self._rec("dispatch_done", stream_id, frame_id,
+                      segment.name, elapsed * 1000.0,
+                      None if out is not None else {"status": "error"})
             self.post_self("resume_stage_segment",
                            [stream_id, frame_id, segment, out,
                             diagnostic, resolved, donated, compile_now,
-                            start, time.perf_counter() - start, frame,
-                            epoch])
+                            start, elapsed, frame, epoch])
 
         self.stage_scheduler.executor(segment.stage_context,
                                       replica).submit(job)
@@ -2952,6 +3286,8 @@ class Pipeline(Actor):
         epoch = frame.replay_epoch      # stale after a replay
         start = time.perf_counter()
         frame.metrics[f"{node_name}_time_start"] = start
+        self._rec("park", stream_id, frame_id, node_name,
+                  info={"kind": "async"})
         if node.element.device_resident:
             frame.metrics["device_dispatches"] = \
                 frame.metrics.get("device_dispatches", 0) + 1
@@ -3024,6 +3360,21 @@ class Pipeline(Actor):
             return                      # pre-replay attempt: void
         frame.paused_pe_name = None
         frame.metrics[f"{node_name}_time"] = elapsed
+        started = frame.metrics.get(f"{node_name}_time_start")
+        if started is not None:
+            # Resume lag: the element finished at started + elapsed;
+            # the continuation then waited for the event loop.  That is
+            # queue time (critical-path bucket) -- without it the
+            # attribution misses exactly the loop-contention the
+            # recorder's event timeline shows.  Accumulates with the
+            # worker-queue stamp (same key) on the stage-worker path.
+            lag_ms = (time.perf_counter() - started - elapsed) * 1000.0
+            if lag_ms > 0.0:
+                key = f"{node_name}_queue_ms"
+                frame.metrics[key] = frame.metrics.get(key, 0.0) \
+                    + lag_ms
+        self._rec("resume", stream.stream_id, frame.frame_id,
+                  node_name, elapsed * 1000.0)
         self.run_hook("pipeline.process_element_post:0",
                       lambda: {"element": node_name,
                                "stream": stream.stream_id,
@@ -3106,19 +3457,23 @@ class Pipeline(Actor):
 
     # -- name mapping ------------------------------------------------------
 
-    def _map_in(self, node, swag: dict) -> tuple[dict, list, list]:
+    def _map_in(self, node, swag: dict, frame: Frame | None = None,
+                stream: Stream | None = None) -> tuple[dict, list, list]:
         """Returns (inputs, missing, host_typed): the host-typed names
         were materialized host-side and must stay there -- a placement
         transfer re-uploading them would undo the contract."""
         return self._map_in_for(node.element, node.properties or {},
-                                swag)
+                                swag, frame=frame, stream=stream)
 
-    def _map_in_for(self, element, mapping: dict, swag: dict) \
+    def _map_in_for(self, element, mapping: dict, swag: dict,
+                    frame: Frame | None = None,
+                    stream: Stream | None = None) \
             -> tuple[dict, list, list]:
         """`_map_in` against an explicit (element, mapping) pair -- the
         graph path shares it with breaker fallbacks, whose element is
         off-graph but resolves inputs through the remote node's
-        mapping."""
+        mapping.  ``frame`` (when given) takes the host-typed fetch's
+        cost as a ``fetch`` critical-path stamp."""
         inputs, missing, host_typed = {}, [], []
         host_inputs = element.host_inputs
         for io in (element.definition.input if element.definition else []):
@@ -3138,8 +3493,13 @@ class Pipeline(Actor):
             # device-resident swag values reach the host mid-graph --
             # ONE counted fetch for all of them together, not an
             # implicit sync inside the element.
+            fetch_start = time.perf_counter()
             inputs.update(self.transfer_ledger.fetch(
                 {name: inputs[name] for name in host_typed}))
+            if frame is not None and stream is not None:
+                self._note_fetch(
+                    stream, frame, element.name,
+                    (time.perf_counter() - fetch_start) * 1000.0)
         return inputs, missing, host_typed
 
     def _element_post_error(self, stream: Stream, frame: Frame,
@@ -3205,6 +3565,9 @@ class Pipeline(Actor):
             time.perf_counter() - frame.metrics["time_pipeline_start"])
         stream.last_frame_time = time.monotonic()   # grace lease clock
         stream.frames.pop(frame.frame_id, None)
+        self._rec("done", stream.stream_id, frame.frame_id,
+                  ms=frame.metrics["time_pipeline"] * 1000.0,
+                  info={"ok": True})
         self._release_stage(stream, frame)
         self._record_stage_costs(frame)
         # The frame COMPLETES without a host sync: its device leaves may
@@ -3294,6 +3657,8 @@ class Pipeline(Actor):
         (reference semantics -- an element error poisons the stream)."""
         self.logger.error("stream %s frame %s: %s",
                           stream.stream_id, frame.frame_id, diagnostic)
+        self._blackbox("stream_error", stream.stream_id,
+                       frame.frame_id, detail=diagnostic)
         self._finish_failed_frame(stream, frame, diagnostic)
         stream.state = StreamState.ERROR
         self.post_self("destroy_stream", [stream.stream_id])
@@ -3311,6 +3676,8 @@ class Pipeline(Actor):
     def _finish_failed_frame(self, stream: Stream, frame: Frame,
                              diagnostic: str):
         stream.frames.pop(frame.frame_id, None)
+        self._rec("done", stream.stream_id, frame.frame_id,
+                  info={"ok": False, "error": str(diagnostic)[:200]})
         # ok=False: when the failed frame was a half-open replica's
         # canary, its failure is the verdict -- the slot re-kills
         # instead of re-admitting a replica that still cannot serve.
@@ -3377,12 +3744,16 @@ class Pipeline(Actor):
         if stage.remote_topic_path is None:
             return False
         frame.paused_pe_name = node.name
-        inputs, _, _ = self._map_in(node, frame.swag)
+        inputs, _, _ = self._map_in(node, frame.swag, frame=frame,
+                                    stream=stream)
         # Forward ALL mapped inputs; the remote pipeline maps what it needs.
         # Process boundary: explicit single fetch before the host codec.
+        fetch_start = time.perf_counter()
         forwarded = self.transfer_ledger.fetch(
             inputs if inputs else {
                 k: v for k, v in frame.swag.items() if "." not in k})
+        self._note_fetch(stream, frame, node.name,
+                         (time.perf_counter() - fetch_start) * 1000.0)
         header = {"stream_id": stream.stream_id,
                   "frame_id": frame.frame_id,
                   "response_topic": self.topic_in}
@@ -3415,6 +3786,10 @@ class Pipeline(Actor):
         self.runtime.message.publish(f"{stage.remote_topic_path}/in",
                                      payload)
         self._count_plane(pipe_bytes, len(payload))
+        self._rec("forward", stream.stream_id, frame.frame_id,
+                  node.name,
+                  info={"path": "mqtt" if pipe_bytes is None
+                        else "pipe"})
         return True
 
     def process_frame_response(self, stream_dict=None, frame_data=None):
@@ -3446,6 +3821,7 @@ class Pipeline(Actor):
             # that node would silently replace its real result.
             return
         okay = str(stream_dict.get("okay", "true")).lower() != "false"
+        round_ms = None
         if self.telemetry is not None:
             # Close the hop span and merge the remote pipeline's spans
             # BEFORE the okay branch: an errored remote round trip
@@ -3453,15 +3829,24 @@ class Pipeline(Actor):
             if frame.remote_span is not None:
                 node_name, span_id, started = frame.remote_span
                 frame.remote_span = None
+                round_ms = (time.time() - started) * 1000.0
+                # Critical-path ``pipe`` bucket: the whole remote round
+                # trip (wire both ways + the remote's own compute --
+                # its internal split is in the returned spans).
+                key = f"remote_{node_name}_ms"
+                frame.metrics[key] = \
+                    frame.metrics.get(key, 0.0) + round_ms
                 frame.spans.append(make_span(
                     frame.trace_id or "", span_id, frame.trace_root,
                     f"remote:{node_name}", "remote", self.name,
                     stream.stream_id, frame.frame_id, started,
-                    (time.time() - started) * 1000.0,
-                    status="ok" if okay else "error"))
+                    round_ms, status="ok" if okay else "error"))
             remote_spans = stream_dict.get("spans")
             if remote_spans:
                 frame.spans.extend(decode_spans(remote_spans))
+        self._rec("response", stream.stream_id, frame.frame_id,
+                  frame.paused_pe_name, round_ms,
+                  None if okay else {"status": "error"})
         breaker = self._stage_breaker(frame.paused_pe_name) \
             if frame.paused_pe_name in self.graph else None
         if not okay:
@@ -3483,7 +3868,8 @@ class Pipeline(Actor):
                                        force_mqtt=True):
                     return
             if breaker is not None:
-                breaker.record_failure()
+                self._breaker_failure(frame.paused_pe_name, breaker,
+                                      stream.stream_id, frame.frame_id)
             self._frame_error(stream, frame,
                               f"remote {frame.paused_pe_name}: "
                               f"{stream_dict.get('diagnostic', '')}")
@@ -3495,13 +3881,15 @@ class Pipeline(Actor):
             # A corrupt-but-parseable response payload: counts against
             # the stage's breaker like any other remote failure.
             if breaker is not None:
-                breaker.record_failure()
+                self._breaker_failure(frame.paused_pe_name, breaker,
+                                      stream.stream_id, frame.frame_id)
             self._frame_error(stream, frame,
                               f"remote {frame.paused_pe_name}: "
                               f"undecodable response ({error})")
             return
         if breaker is not None:
-            breaker.record_success()
+            self._breaker_success(frame.paused_pe_name, breaker,
+                                  stream.stream_id, frame.frame_id)
         node = self.graph.get_node(frame.paused_pe_name)
         self._map_out(node, frame, outputs)
         resume_after = frame.paused_pe_name

@@ -1003,6 +1003,20 @@ def bench_pipeline_e2e() -> dict:
             if prior and result.get(key):
                 result[f"{key}_vs_baseline"] = round(
                     result[key] / prior, 2)
+        # Critical-path attribution (ISSUE 10): the aggregate bucket
+        # split over the run's traces -- the e2e/device fps gap ships
+        # with a NAMED cause (detect compute vs queue wait vs hop vs
+        # fetch ...), not just per-element percentiles.
+        explanation = pipeline.explain(top_k=3)
+        if explanation.get("top"):
+            top = explanation["top"][0]
+            result["pipeline_e2e_top_bucket"] = \
+                f"{top['stage']}:{top['bucket']}"
+            result["pipeline_e2e_bucket_ms"] = {
+                bucket: round(ms, 1) for bucket, ms
+                in explanation["buckets"].items()}
+            result["pipeline_e2e_attribution_coverage"] = \
+                explanation.get("coverage")
     runtime.terminate()
     if device_best is None:
         result["pipeline_e2e_device_error"] = device_error
@@ -1522,6 +1536,129 @@ def bench_pipeline_stages() -> dict:
                 "hop_overlap_ms", "pipeline_stages_p50_ms",
                 "pipeline_stages_p99_ms", "stage_detect_p99_ms",
                 "stage_llm_p99_ms"):
+        prior = previous.get(key)
+        if prior and result.get(key):
+            result[f"{key}_vs_baseline"] = round(result[key] / prior, 2)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# 4b'. Flight recorder + critical-path attribution (ISSUE 10): the
+#      always-on event ring's e2e fps cost (recorder on vs off on the
+#      same stage-parallel pipeline -- the overhead gate is <= 1%), and
+#      the aggregate bucket attribution (where the time went) for the
+#      timed pass.
+
+EXPLAIN_FRAMES = 32
+EXPLAIN_PASSES = 3
+
+
+def bench_pipeline_explain() -> dict:
+    import numpy as np
+    import jax
+
+    if len(jax.devices()) < 2:
+        return {"pipeline_explain_skipped":
+                f"needs >= 2 devices, have {len(jax.devices())}"}
+    from aiko_services_tpu.pipeline import Pipeline
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.transport import reset_broker
+
+    reset_broker()
+    reset_process()
+    runtime = init_process(transport="loopback")
+    runtime.initialize()
+    n = len(jax.devices())
+
+    def definition(mode):
+        return {
+            "version": 0, "name": f"bench_explain_{mode}",
+            "runtime": "jax",
+            "graph": ["(detect llm)"],
+            "parameters": {"transfer_guard": "disallow",
+                           "device_inflight": 3,
+                           "recorder": mode},
+            "elements": [
+                {**element("detect", "StageWork", ["x"], ["x"],
+                           {"busy_ms": STAGE_BUSY_MS, "factor": 2.0}),
+                 "placement": {"devices": n // 2}},
+                {**element("llm", "StageWork", ["x"], ["x"],
+                           {"busy_ms": STAGE_BUSY_MS, "factor": 3.0}),
+                 "placement": {"devices": n - n // 2}},
+            ]}
+
+    rng = np.random.default_rng(0)
+    frames = [rng.standard_normal((64, 64)).astype(np.float32)
+              for _ in range(4)]
+
+    def run_mode(mode):
+        pipeline = Pipeline(definition(mode), runtime=runtime)
+        responses: "queue.Queue" = queue.Queue()
+        collected: list = []
+
+        def pump(count):
+            for i in range(count):
+                pipeline.process_frame_local(
+                    {"x": frames[i % len(frames)]},
+                    stream_id=f"explain_{mode}",
+                    queue_response=responses)
+
+        def drain(target):
+            while not responses.empty():
+                collected.append(responses.get())
+            return len(collected) >= target
+
+        pump(4)                                     # warm the jits
+        runtime.run(until=lambda: drain(4), timeout=600.0)
+        if len(collected) < 4:
+            pipeline.stop()
+            return None, None, f"{mode} warmup stalled"
+        best = None
+        for _ in range(EXPLAIN_PASSES):             # min-of-N denoises
+            collected.clear()
+            start = time.perf_counter()
+            pump(EXPLAIN_FRAMES)
+            runtime.run(until=lambda: drain(EXPLAIN_FRAMES),
+                        timeout=600.0)
+            elapsed = time.perf_counter() - start
+            if len(collected) < EXPLAIN_FRAMES \
+                    or not all(row[4] for row in collected):
+                pipeline.stop()
+                return None, None, f"{mode} pass incomplete"
+            best = elapsed if best is None else min(best, elapsed)
+        explanation = pipeline.explain(top_k=3)
+        pipeline.stop()
+        return best, explanation, None
+
+    result: dict = {}
+    off_elapsed, _, error = run_mode("off")
+    if error:
+        runtime.terminate()
+        return {"pipeline_explain_error": error}
+    on_elapsed, explanation, error = run_mode("on")
+    runtime.terminate()
+    if error:
+        return {"pipeline_explain_error": error}
+    fps_off = EXPLAIN_FRAMES / off_elapsed
+    fps_on = EXPLAIN_FRAMES / on_elapsed
+    result.update({
+        "pipeline_explain_fps_recorder_off": round(fps_off, 2),
+        "pipeline_explain_fps_recorder_on": round(fps_on, 2),
+        # The gate: <= 1% (negative = within noise, recorder free).
+        "pipeline_explain_recorder_overhead_pct": round(
+            (fps_off - fps_on) / fps_off * 100.0, 2) if fps_off else None,
+    })
+    if explanation and explanation.get("top"):
+        top = explanation["top"][0]
+        result["pipeline_explain_top_bucket"] = \
+            f"{top['stage']}:{top['bucket']}"
+        result["pipeline_explain_buckets"] = {
+            bucket: round(ms, 1) for bucket, ms
+            in explanation["buckets"].items()}
+        result["pipeline_explain_coverage"] = explanation.get("coverage")
+    previous = _previous_bench()
+    for key in ("pipeline_explain_fps_recorder_on",
+                "pipeline_explain_recorder_overhead_pct"):
         prior = previous.get(key)
         if prior and result.get(key):
             result[f"{key}_vs_baseline"] = round(result[key] / prior, 2)
@@ -2177,6 +2314,7 @@ def main() -> int:
             ("bench_pipeline_fusion", bench_pipeline_fusion),
             ("bench_pipeline_transport", bench_pipeline_transport),
             ("bench_pipeline_stages", bench_pipeline_stages),
+            ("bench_pipeline_explain", bench_pipeline_explain),
             ("bench_pipeline_faults", bench_pipeline_faults),
             ("bench_pipeline_replicas", bench_pipeline_replicas),
             ("bench_asr", lambda: bench_asr(rtt)),

@@ -1,0 +1,6 @@
+"""Emits a metric series no README metrics table documents."""
+
+
+class Knobs:
+    def tick(self, registry):
+        registry.count("mystery_metric_total")

@@ -110,6 +110,62 @@ def test_registry_labels_and_render_text():
     assert registry.summaries() == []
 
 
+def test_render_text_exposition_format():
+    """Prometheus exposition contract (ISSUE 10 satellite): summaries
+    carry `_sum`/`_count` per labeled series (so dashboards can
+    compute rates/averages), one TYPE line per name, and NO duplicate
+    samples -- a counter and a same-name gauge must not both emit (a
+    duplicate sample invalidates the whole scrape)."""
+    registry = MetricsRegistry()
+    for value in (2.0, 4.0):
+        registry.observe("latency_ms", value, element="A")
+    registry.observe("latency_ms", 8.0, element="B")
+    registry.count("frames_replayed", 3)
+    registry.gauge("frames_replayed", 99)       # same-name refresh
+    registry.gauge("depth", 7, stage="s")
+    text = registry.render_text()
+    lines = text.splitlines()
+    # _sum/_count per labeled series, summing the observations
+    assert 'aiko_latency_ms_sum{element="A"} 6' in text
+    assert 'aiko_latency_ms_count{element="A"} 2' in text
+    assert 'aiko_latency_ms_sum{element="B"} 8' in text
+    assert 'aiko_latency_ms_count{element="B"} 1' in text
+    # quantile samples carry the label plus quantile
+    assert any(line.startswith('aiko_latency_ms{element="A"'
+                               ',quantile="0.5"}') for line in lines)
+    # one TYPE line per metric name
+    type_lines = [line for line in lines if line.startswith("# TYPE")]
+    assert len(type_lines) == len(set(type_lines))
+    assert "# TYPE aiko_latency_ms summary" in type_lines
+    # counter wins over the same-name gauge: exactly ONE sample
+    samples = [line for line in lines
+               if line.split("{")[0].split(" ")[0]
+               == "aiko_frames_replayed"]
+    assert samples == ["aiko_frames_replayed 3"]
+    # no duplicate (name, labels) samples anywhere
+    keys = [line.rsplit(" ", 1)[0] for line in lines
+            if not line.startswith("#")]
+    assert len(keys) == len(set(keys)), sorted(keys)
+
+
+def test_pipeline_scrape_has_no_duplicate_samples(runtime):
+    """Integration twin: after recovery counters fire (replay/shed
+    share mirrors), a full metrics_text() scrape still has unique
+    (name, labels) samples."""
+    pipeline = simple_pipeline(runtime, name="p_dup")
+    pump(runtime, pipeline, 3)
+    # force the recovery counters that USED to be double-emitted
+    pipeline.telemetry.registry.count("frames_replayed")
+    pipeline.telemetry.registry.count("frames_shed")
+    pipeline.telemetry.registry.count("deadline_misses")
+    lines = [line for line in pipeline.metrics_text().splitlines()
+             if line and not line.startswith("#")]
+    keys = [line.rsplit(" ", 1)[0] for line in lines]
+    duplicates = {key for key in keys if keys.count(key) > 1}
+    assert not duplicates, duplicates
+    pipeline.stop()
+
+
 def test_registry_thread_safety_smoke():
     registry = MetricsRegistry()
     stop = threading.Event()
@@ -327,6 +383,84 @@ def test_stream_destroy_purges_telemetry_state(runtime):
 
 
 # -- HTTP export surface ----------------------------------------------------
+
+def test_metrics_server_under_churn(runtime):
+    """ISSUE 10 satellite: concurrent scrapes (/metrics + /traces)
+    against a pipeline under stream churn AND a mid-flight device
+    replacement -- every response is a 200 with a parseable body (no
+    500s, no torn reads, no unbounded /traces bodies)."""
+    definition = {
+        "version": 0, "name": "p_churn", "runtime": "jax",
+        "graph": ["(sa (sb))"],
+        "elements": [
+            {"name": name, "input": [{"name": "x"}],
+             "output": [{"name": "x"}],
+             "parameters": {"busy_ms": 2.0},
+             "placement": {"mesh": {"dp": 4}},
+             "deploy": {"local": {
+                 "module": COMMON, "class_name": "StageWork"}}}
+            for name in ("sa", "sb")]}
+    import numpy as np
+
+    pipeline = Pipeline(definition, runtime=runtime)
+    server = MetricsServer(pipeline, port=0, host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.port}"
+    errors, bodies = [], [0]
+    stop = threading.Event()
+
+    def scraper(path):
+        while not stop.is_set():
+            try:
+                body = urllib.request.urlopen(f"{base}{path}",
+                                              timeout=5.0).read()
+                if path == "/metrics":
+                    assert body.decode().startswith("#")
+                elif path == "/traces":
+                    payload = json.loads(body)
+                    assert len(payload["traces"]) <= 50
+                else:                       # /explain
+                    payload = json.loads(body)
+                    assert set(payload["buckets"]) and len(
+                        payload.get("top", [])) <= 5
+                bodies[0] += 1
+            except Exception as error:      # pragma: no cover
+                errors.append((path, error))
+                return
+
+    threads = [threading.Thread(target=scraper, args=(path,))
+               for path in ("/metrics", "/traces", "/explain")]
+    for thread in threads:
+        thread.start()
+    responses = queue.Queue()
+    try:
+        for round_index in range(3):
+            stream_id = f"s{round_index}"
+            for i in range(6):
+                pipeline.process_frame_local(
+                    {"x": np.float32(i)}, stream_id=stream_id,
+                    queue_response=responses)
+            if round_index == 1:
+                # mid-flight replacement while scrapes continue
+                dead = list(pipeline.stage_placement.plans["sa"]
+                            .mesh.devices.flat)[:2]
+                pipeline.post_self("replace_failed_devices", [dead],
+                                   delay=0.005)
+            assert run_until(runtime,
+                             lambda: responses.qsize()
+                             >= 6 * (round_index + 1), timeout=60.0)
+            pipeline.post_self("destroy_stream", [stream_id])
+            run_until(runtime,
+                      lambda: stream_id not in pipeline.streams,
+                      timeout=10.0)
+    finally:
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=10.0)
+        server.stop()
+        pipeline.stop()
+    assert not errors, errors
+    assert bodies[0] > 0
+
 
 def test_metrics_http_endpoint(runtime):
     pipeline = simple_pipeline(runtime, name="p_http")

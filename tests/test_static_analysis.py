@@ -52,6 +52,7 @@ SELFCHECK_FIXTURES = {
     "span_sync": "span-sync",
     "resume_identity": "resume-identity",
     "parameter_registry": "parameter-registry",
+    "metric_registry": "metric-registry",
 }
 
 
