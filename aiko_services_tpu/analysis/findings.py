@@ -136,6 +136,15 @@ RULES: dict[str, tuple[str, str]] = {
                         "metric series emitted in source but missing "
                         "from the README metrics table, or documented "
                         "there but never emitted"),
+    "kernel-test": (ERROR,
+                    "a pl.pallas_call kernel entry point has no "
+                    "registered equivalence test "
+                    "(KERNEL_EQUIVALENCE_TESTS), or registers one "
+                    "that does not exist in tests/"),
+    "kernel-table": (ERROR,
+                     "kernel registered in code but missing from the "
+                     "README kernel-plane table, or documented there "
+                     "but not registered"),
 }
 
 

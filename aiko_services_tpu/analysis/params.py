@@ -38,6 +38,7 @@ class ParamSpec:
     choices: tuple = ()             # enum domain ("" allows absence)
     number: bool = False            # must parse as a number
     minimum: float | None = None    # inclusive lower bound
+    maximum: float | None = None    # inclusive upper bound
     kind: str = "string"            # free-form: string | json
 
 
@@ -216,6 +217,18 @@ ELEMENT_PARAMETERS: dict[tuple[str, str], dict[str, ParamSpec]] = {
         "max_slots": ParamSpec(
             "device batch width (concurrent request slots)",
             number=True, minimum=1),
+        # -- kernel plane (ISSUE 11) ----------------------------------
+        "decode_kernel": ParamSpec(
+            "decode-attention backend in the ops capability-probe "
+            "vocabulary (ops.decode_backend); auto follows the cache "
+            "structure and extent threshold",
+            choices=("auto", "paged-kernel", "dense-flash",
+                     "reference")),
+        "sample_top_k": ParamSpec(
+            "restrict sampled rows to the k highest logits via the "
+            "ops top-k interface (0 = full-vocab categorical; the "
+            "kernel holds candidates in one 128-lane tile)",
+            number=True, minimum=0, maximum=128),
     },
 }
 
@@ -249,6 +262,10 @@ def _check_value(name: str, spec: ParamSpec, value, spot: str) \
             return Finding(
                 "bad-parameter",
                 f"{name}={value!r}: must be >= {spec.minimum:g}", spot)
+        if spec.maximum is not None and number > spec.maximum:
+            return Finding(
+                "bad-parameter",
+                f"{name}={value!r}: must be <= {spec.maximum:g}", spot)
         return None
     if spec.kind == "json" and name == "fault_plan" and value:
         try:

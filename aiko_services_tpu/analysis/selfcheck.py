@@ -30,6 +30,17 @@ returns :class:`~.findings.Finding`s:
                       every table row is still emitted somewhere --
                       the replica/LLM/data-plane gauges of PRs 7-9
                       drifted from the docs exactly this way.
+- ``kernel-test``     every ``pl.pallas_call`` kernel entry point is
+                      registered in its module's
+                      ``KERNEL_EQUIVALENCE_TESTS`` with a test that
+                      actually exists in tests/ -- an untested kernel
+                      fails ``--self`` (ISSUE 11: the static-analysis
+                      discipline applied to the kernel plane).
+- ``kernel-table``    the registered kernel entries and the README
+                      kernel-plane table (the ``<!-- kernel-table -->``
+                      fenced region) agree both ways, so the per-kernel
+                      shapes/support/fallback table cannot drift from
+                      the code.
 
 All rules accept an explicit root so the fixture corpus can point them
 at deliberately broken trees.
@@ -344,6 +355,114 @@ def _check_metric_registry(root: Path, readme: Path | None) -> list:
     return findings
 
 
+#: module-level registry literal the kernel rules read (AST, never
+#: imported): ``KERNEL_EQUIVALENCE_TESTS = {"entry": "file::test"}``.
+_KERNEL_REGISTRY = "KERNEL_EQUIVALENCE_TESTS"
+#: README kernel-plane table rows inside the fenced region: | `name` |
+_KERNEL_REGION = re.compile(
+    r"<!--\s*kernel-table\s*-->(.*?)<!--\s*/kernel-table\s*-->", re.S)
+_KERNEL_ROW = re.compile(r"^\|\s*`([a-z_0-9]+)`", re.M)
+
+
+def _kernel_module_facts(tree: ast.Module):
+    """(top-level defs, defs containing a ``pl.pallas_call`` with line
+    numbers, the KERNEL_EQUIVALENCE_TESTS literal or None)."""
+    defs: dict[str, int] = {}
+    entries: dict[str, int] = {}
+    registry = None
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            defs[node.name] = node.lineno
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call) \
+                        and isinstance(inner.func, ast.Attribute) \
+                        and inner.func.attr == "pallas_call":
+                    entries[node.name] = inner.lineno
+        elif isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == _KERNEL_REGISTRY
+                        for t in node.targets) \
+                and isinstance(node.value, ast.Dict):
+            registry = {}
+            for key, value in zip(node.value.keys, node.value.values):
+                if isinstance(key, ast.Constant) \
+                        and isinstance(value, ast.Constant):
+                    registry[str(key.value)] = (str(value.value),
+                                                key.lineno)
+    return defs, entries, registry
+
+
+def _check_kernel_registry(root: Path, readme: Path | None) -> list:
+    """``kernel-test``: every pl.pallas_call entry point must be
+    registered with an equivalence test that exists (name-matched in
+    tests/); ``kernel-table``: registered entries and the README
+    kernel-plane table agree both ways."""
+    findings = []
+    tests_dir = root / "tests"
+    if not tests_dir.is_dir():
+        tests_dir = root.parent / "tests"
+    registered: dict[str, str] = {}
+    for path, text in _sources(root):
+        # Relative to the scanned root: a fixture tree may itself live
+        # under a tests/ directory.
+        if "tests" in path.relative_to(root).parts \
+                or path.name.startswith("test"):
+            continue
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        defs, entries, registry = _kernel_module_facts(tree)
+        where = str(path.relative_to(root))
+        for name, line in sorted(entries.items()):
+            if registry is None or name not in registry:
+                findings.append(Finding(
+                    "kernel-test",
+                    f"pl.pallas_call entry {name!r} has no registered "
+                    f"equivalence test ({_KERNEL_REGISTRY} in its "
+                    f"module) -- an untested kernel cannot gate PRs",
+                    f"{where}:{line}"))
+        for name, (ref, line) in sorted((registry or {}).items()):
+            spot = f"{where}:{line}"
+            if name not in defs:
+                findings.append(Finding(
+                    "kernel-test",
+                    f"{_KERNEL_REGISTRY} registers {name!r}, which the "
+                    f"module does not define", spot))
+                continue
+            test_file, sep, test_name = ref.partition("::")
+            test_path = tests_dir / test_file
+            if not sep or not test_path.is_file() \
+                    or f"def {test_name}(" not in test_path.read_text():
+                findings.append(Finding(
+                    "kernel-test",
+                    f"kernel {name!r} registers equivalence test "
+                    f"{ref!r}, which does not exist under "
+                    f"{tests_dir.name}/", spot))
+            registered[name] = spot
+    if readme is None:
+        candidate = root / "README.md"
+        readme = candidate if candidate.is_file() else None
+    readme_text = readme.read_text() if readme and readme.is_file() \
+        else ""
+    region = _KERNEL_REGION.search(readme_text)
+    documented = set(_KERNEL_ROW.findall(region.group(1))) if region \
+        else set()
+    for name, spot in sorted(registered.items()):
+        if name not in documented:
+            findings.append(Finding(
+                "kernel-table",
+                f"kernel {name!r} is registered but not a row of the "
+                f"README kernel-plane table "
+                f"(<!-- kernel-table --> region)", spot))
+    for row in sorted(documented - set(registered)):
+        findings.append(Finding(
+            "kernel-table",
+            f"README kernel-plane table documents {row!r}, which no "
+            f"module registers", "README.md"))
+    return findings
+
+
 def analyze_framework(package_root: Path | str | None = None,
                       readme: Path | str | None = None,
                       registry: dict | None = None) -> list:
@@ -361,4 +480,5 @@ def analyze_framework(package_root: Path | str | None = None,
     findings.extend(_check_resume_identity(root))
     findings.extend(_check_parameter_registry(root, readme, registry))
     findings.extend(_check_metric_registry(root, readme))
+    findings.extend(_check_kernel_registry(root, readme))
     return findings

@@ -274,7 +274,8 @@ class LLM(PipelineElement):
                      "seed", "attention", "model", "quantize",
                      "decode_block", "inflight", "max_slots",
                      "decode_block_tokens", "speculative", "spec_tokens",
-                     "spec_window", "kv_page_tokens", "kv_pages")
+                     "spec_window", "kv_page_tokens", "kv_pages",
+                     "decode_kernel", "sample_top_k")
 
     def _resolve_model_params(self) -> dict:
         resolved = {}
@@ -322,6 +323,26 @@ class LLM(PipelineElement):
         config = dataclasses.replace(
             base, max_seq=int(settings.get("max_seq", 256)),
             attention=str(settings.get("attention", "dense")))
+        # ``decode_kernel`` selects the decode-attention backend in the
+        # ops capability-probe vocabulary (ops.decode_backend):
+        # paged-kernel / dense-flash force the Pallas kernel plane
+        # (which one actually engages follows the cache's structure),
+        # reference forces the dense einsum path, auto defers to the
+        # extent threshold.  Domain-validated at create time
+        # (analysis/params.py ELEMENT_PARAMETERS).
+        decode_kernel = str(settings.get("decode_kernel",
+                                         "auto")).strip().lower()
+        kernel_to_attention = {"auto": "auto", "paged-kernel": "flash",
+                               "dense-flash": "flash",
+                               "reference": "dense"}
+        if decode_kernel not in kernel_to_attention:
+            raise ValueError(
+                f"decode_kernel={decode_kernel!r}: one of "
+                f"{'|'.join(sorted(kernel_to_attention))}")
+        if decode_kernel != "auto":
+            config = dataclasses.replace(
+                config,
+                decode_attention=kernel_to_attention[decode_kernel])
         params = _restore(
             llama.init_params(
                 jax.random.PRNGKey(int(settings.get("seed", 0))), config),
@@ -356,6 +377,7 @@ class LLM(PipelineElement):
             spec_window=int(settings.get("spec_window", 32)),
             kv_page_tokens=int(settings.get("kv_page_tokens", 0)),
             kv_pages=None if kv_pages is None else int(kv_pages),
+            sample_top_k=int(settings.get("sample_top_k", 0)),
             fetch=None if ledger is None
             else (lambda tree: ledger.fetch(tree, label="llm_block")),
             fault_probe=self._fault_probe,

@@ -119,7 +119,8 @@ class Request:
     first_time: float = 0.0
 
 
-_select_tokens = jax.jit(llama.select_tokens)
+_select_tokens = jax.jit(llama.select_tokens,
+                         static_argnames=("top_k",))
 
 
 class _InflightBlock:
@@ -160,9 +161,13 @@ class ContinuousBatcher:
                  kv_pages: int | None = None,
                  fetch: Callable | None = None,
                  fault_probe: Callable | None = None,
-                 on_block: Callable | None = None):
+                 on_block: Callable | None = None,
+                 sample_top_k: int = 0):
         self.params = params
-        self.config = config
+        # A pre-sharded (TP/fsdp) quantized tree must keep XLA's
+        # matmul path -- resolved here, where the concrete leaves'
+        # sharding is visible (llama._matmul_safe_config).
+        self.config = llama._matmul_safe_config(config, params)
         self.max_slots = max_slots
         self.max_seq = max_seq or config.max_seq
         self.prefill_chunk = min(prefill_chunk, self.max_seq)
@@ -207,6 +212,19 @@ class ContinuousBatcher:
                 f"1 = {self.spec_tokens + 1}); raise the ring or "
                 f"lower spec_tokens")
         self.spec_window = max(4, int(spec_window))
+        # Restrict sampled rows to the k highest logits (0 = full
+        # categorical).  Static per-trace: rides llama.select_tokens /
+        # decode_loop / decode_block through the ops top-k interface
+        # (the Pallas kernel on TPU, lax.top_k elsewhere); greedy rows
+        # are unaffected either way.  Bounded at build to the kernel's
+        # lane cap so a CPU-tested config cannot blow up mid-serving
+        # on TPU (the create-time domain check mirrors this bound).
+        self.sample_top_k = max(0, int(sample_top_k))
+        if self.sample_top_k > 128:
+            raise ValueError(
+                f"sample_top_k={self.sample_top_k}: the on-TPU top-k "
+                f"kernel holds candidates in one 128-lane tile; use "
+                f"k <= 128 (0 = full-vocab categorical)")
         self._draft = draft_params(params) \
             if self.speculative == "draft" else None
         # Paged KV cache (models/paged.py): fixed-size pages + per-slot
@@ -554,7 +572,8 @@ class ContinuousBatcher:
             jnp.asarray(write_positions))
         self._key, sub = jax.random.split(self._key)
         next_tokens = np.asarray(jax.device_get(_select_tokens(
-            sub, logits, jnp.asarray(self.temperatures))), dtype=np.int32)
+            sub, logits, jnp.asarray(self.temperatures),
+            top_k=self.sample_top_k)), dtype=np.int32)
         self.steps += 1
         for i in decoding:
             request = self.slots[i]
@@ -609,7 +628,8 @@ class ContinuousBatcher:
             llama.decode_block(
                 self.params, self.config, tokens, self.cache, lengths,
                 self._active_dev, self._temps_dev, self._key,
-                num_steps=self.decode_block)
+                num_steps=self.decode_block,
+                top_k=self.sample_top_k)
         emitted.copy_to_host_async()
         self._chain = (tokens_n, lengths_n)
         for i in decoding:                      # host mirror (clamped)
@@ -754,7 +774,8 @@ class ContinuousBatcher:
             self.params, self.config, tokens, self.cache, lengths,
             active, budget, temps_dev, eos_dev, history, key,
             ring=ring, speculative=self.speculative,
-            spec_tokens=self.spec_tokens, draft=self._draft)
+            spec_tokens=self.spec_tokens, draft=self._draft,
+            top_k=self.sample_top_k)
         # Only what the retire actually reads rides the counted fetch
         # (the active/budget/history carries chain device-side).
         tree = {"emitted": emitted, "counts": counts,

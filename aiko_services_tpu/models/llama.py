@@ -19,6 +19,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..ops import decode_backend, matmul_backend
 from ..ops.layers import (rms_norm, rope_frequencies, apply_rope,
                           attention_prefill, attention_decode_append)
 from ..parallel.mesh import P
@@ -67,6 +68,13 @@ class LlamaConfig:
     # benched configs -- compose fine.
     decode_attention: str = "auto"
     flash_decode_threshold: int = 1024
+    # Weight-only-int8 matmul implementation for UNSTACKED quantized
+    # leaves (today: the unembed projection, serving's largest matmul):
+    # "auto" (the fused Pallas dequant-matmul on TPU, XLA's
+    # cast-into-the-dot elsewhere), "pallas" (force the kernel --
+    # interpret mode off-TPU, the equivalence-test setting), "off"
+    # (always XLA).  Resolved via ops.matmul_backend at trace time.
+    matmul_kernel: str = "auto"
     # KV cache storage: "bfloat16" or "int8" (per-token-per-head scales,
     # models/quant.py:quantize_kv).  Decode streams the whole cache every
     # step, so at long context the cache -- not the weights -- dominates
@@ -103,6 +111,10 @@ class LlamaConfig:
             raise ValueError(
                 f"kv_dtype must be 'bfloat16' or 'int8', "
                 f"got {self.kv_dtype!r}")
+        if self.matmul_kernel not in ("auto", "pallas", "off"):
+            raise ValueError(
+                f"matmul_kernel must be 'auto', 'pallas' or 'off', "
+                f"got {self.matmul_kernel!r}")
         if self.n_experts and self.n_experts_per_token > self.n_experts:
             raise ValueError(
                 f"n_experts_per_token ({self.n_experts_per_token}) "
@@ -334,13 +346,27 @@ def cache_extent(cache: dict) -> int:
     return cache_array(cache).shape[2]
 
 
-def matmul(x, w):
+def matmul(x, w, kernel: bool = False):
     """``x @ w`` for raw arrays or weight-only-int8 leaves
     (``{"int8", "scale"}``, models/quant.py).  The int8->bf16 convert
     fuses into the dot's operand load on TPU, so int8 weights stream
     half the HBM bytes; the per-output-channel scale applies after the
-    dot -- no dequantized weight tensor is ever materialized."""
+    dot -- no dequantized weight tensor is ever materialized.
+
+    ``kernel=True`` routes an UNSTACKED quantized leaf through the
+    fused Pallas dequant-matmul (ops/pallas_matmul.py): cast, dot and
+    scale in one kernel, no unscaled [M, F] intermediate.  Callers gate
+    it on :func:`aiko_services_tpu.ops.matmul_backend` (the in-scan
+    layer leaves stay on the XLA path -- a sliced operand in front of a
+    pallas call would materialize; the scan-invariant unembed is the
+    high-leverage site, see :func:`_finish`)."""
     if is_quantized(w):
+        if kernel and w["int8"].ndim == 2:
+            from ..ops.pallas_matmul import int8_matmul
+            lead = x.shape[:-1]
+            out = int8_matmul(x.reshape(-1, x.shape[-1]), w["int8"],
+                              w["scale"])
+            return out.reshape(*lead, out.shape[-1])
         return (x @ w["int8"].astype(x.dtype)) \
             * w["scale"].astype(x.dtype)
     return x @ w
@@ -491,9 +517,18 @@ def _finish(params: dict, config: LlamaConfig, hidden) -> jax.Array:
     """Final norm + unembed, shared by _forward_layers and the flash
     decode scan (which carries a layer INDEX instead of cache slices --
     keep the two scaffolds in sync through this helper; decode never
-    differentiates, so config.remat is irrelevant there)."""
+    differentiates, so config.remat is irrelevant there).
+
+    A quantized unembed dispatches through the fused Pallas
+    dequant-matmul when ``config.matmul_kernel`` resolves to it
+    (ops.matmul_backend): the unembed is the single largest serving
+    matmul AND scan-invariant (closure-captured whole even inside the
+    draft scan), so no per-layer slice materializes in front of the
+    pallas call."""
     hidden = rms_norm(hidden, params["final_norm"], config.norm_eps)
-    return matmul(hidden, params["unembed"])
+    return matmul(hidden, params["unembed"],
+                  kernel=(matmul_backend(config.matmul_kernel)
+                          != "reference"))
 
 
 def _prefill_core(params: dict, config: LlamaConfig, tokens: jax.Array,
@@ -533,8 +568,8 @@ def _prefill_core(params: dict, config: LlamaConfig, tokens: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
-            cache: dict, start_positions: jax.Array) \
+def _prefill_jit(params: dict, config: LlamaConfig, tokens: jax.Array,
+                 cache: dict, start_positions: jax.Array) \
         -> tuple[jax.Array, dict]:
     """Process a prompt chunk, writing the cache.
 
@@ -546,6 +581,20 @@ def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
     logits, cache, _ = _prefill_core(params, config, tokens, cache,
                                      start_positions)
     return logits, cache
+
+
+def prefill(params: dict, config: LlamaConfig, tokens: jax.Array,
+            cache: dict, start_positions: jax.Array) \
+        -> tuple[jax.Array, dict]:
+    """Whole-batch prompt prefill (see _prefill_jit); a distributed
+    quantized unembed resolves the matmul kernel off here, where the
+    concrete tree's sharding is visible (_matmul_safe_config -- the
+    decode wrappers' discipline)."""
+    return _prefill_jit(params, _matmul_safe_config(config, params),
+                        tokens, cache, start_positions)
+
+
+prefill.__wrapped__ = _prefill_jit.__wrapped__
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -560,9 +609,10 @@ def prefill_with_aux(params: dict, config: LlamaConfig,
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def prefill_into_slot(params: dict, config: LlamaConfig,
-                      tokens: jax.Array, cache: dict, slot: jax.Array,
-                      start: jax.Array) -> tuple[jax.Array, dict]:
+def _prefill_into_slot_jit(params: dict, config: LlamaConfig,
+                           tokens: jax.Array, cache: dict,
+                           slot: jax.Array,
+                           start: jax.Array) -> tuple[jax.Array, dict]:
     """Process one prompt chunk for ONE sequence, writing its KV directly
     into batch row ``slot`` of the BATCHED cache (no scratch cache, no
     full-extent scatter -- the continuous batcher's admission path).
@@ -645,10 +695,25 @@ def prefill_into_slot(params: dict, config: LlamaConfig,
     return logits, new_cache
 
 
+def prefill_into_slot(params: dict, config: LlamaConfig,
+                      tokens: jax.Array, cache: dict, slot: jax.Array,
+                      start: jax.Array) -> tuple[jax.Array, dict]:
+    """Single-slot admission (see _prefill_into_slot_jit); the matmul
+    kernel resolves eagerly on the concrete tree's sharding, as in
+    :func:`prefill`."""
+    return _prefill_into_slot_jit(
+        params, _matmul_safe_config(config, params), tokens, cache,
+        slot, start)
+
+
+prefill_into_slot.__wrapped__ = _prefill_into_slot_jit.__wrapped__
+
+
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def prefill_into_slots(params: dict, config: LlamaConfig,
-                       tokens: jax.Array, cache: dict, slots: jax.Array,
-                       starts: jax.Array) -> tuple[jax.Array, dict]:
+def _prefill_into_slots_jit(params: dict, config: LlamaConfig,
+                            tokens: jax.Array, cache: dict,
+                            slots: jax.Array,
+                            starts: jax.Array) -> tuple[jax.Array, dict]:
     """Batched multi-slot admission: process one prompt chunk for N
     sequences in ONE dispatch, each row writing its KV into its own
     batch row of the cache (the batcher's burst-admission path -- N
@@ -728,6 +793,20 @@ def prefill_into_slots(params: dict, config: LlamaConfig,
     return logits, new_cache
 
 
+def prefill_into_slots(params: dict, config: LlamaConfig,
+                       tokens: jax.Array, cache: dict, slots: jax.Array,
+                       starts: jax.Array) -> tuple[jax.Array, dict]:
+    """Batched multi-slot admission (see _prefill_into_slots_jit); the
+    matmul kernel resolves eagerly on the concrete tree's sharding, as
+    in :func:`prefill`."""
+    return _prefill_into_slots_jit(
+        params, _matmul_safe_config(config, params), tokens, cache,
+        slots, starts)
+
+
+prefill_into_slots.__wrapped__ = _prefill_into_slots_jit.__wrapped__
+
+
 def _cache_distributed(cache) -> bool:
     """True when the cache payload lives sharded across more than one
     device.  The Pallas decode kernel (a custom call) has no GSPMD
@@ -735,45 +814,33 @@ def _cache_distributed(cache) -> bool:
     every layer -- dense attention, whose einsums GSPMD partitions
     natively, is always faster there.  Tracers (calls from inside
     another jit) carry no sharding and resolve as resident."""
-    arr = cache_array(cache)
-    sharding = getattr(arr, "sharding", None)
-    if sharding is None:
-        return False
-    try:
-        return (len(sharding.device_set) > 1
-                and not sharding.is_fully_replicated)
-    except (AttributeError, TypeError):
-        return False
+    return _distributed_array(cache_array(cache))
 
 
 def _resolve_decode_flash(c: LlamaConfig, cache: dict) -> bool:
-    """Pick the decode attention path EAGERLY (outside jit), where the
-    cache's sharding is visible.  'auto' silently keeps dense for a
-    distributed cache; explicit 'flash' raises rather than compiling a
-    per-layer all-gather of the whole cache.  A PAGED cache is
-    dense-only: the Pallas kernel indexes the flat stacked cache in
-    its BlockSpecs, and there is no paged-attention kernel (yet)."""
-    if is_paged(cache):
-        if c.decode_attention == "flash":
-            raise ValueError(
-                "decode_attention='flash' cannot serve a paged KV "
-                "cache (the kernel's BlockSpecs index the flat dense "
-                "cache); use 'dense' or 'auto' with kv_page_tokens")
-        return False
-    if c.decode_attention == "flash":
-        if _cache_distributed(cache):
-            raise ValueError(
-                "decode_attention='flash' needs the KV cache resident "
-                "on one device (pallas_call has no GSPMD partitioning "
-                "rules; a tp/dp-sharded cache would be all-gathered in "
-                "full every layer).  Use 'dense' -- or 'auto', which "
-                "falls back -- when serving with a sharded cache.")
-        return True
-    extent = cache_array(cache).shape[2]
-    return (c.decode_attention == "auto"
-            and extent >= c.flash_decode_threshold
-            and extent % 128 == 0
-            and not _cache_distributed(cache))
+    """Pick the decode attention backend EAGERLY (outside jit), where
+    the cache's sharding and structure are visible, through the ops
+    capability probe (:func:`aiko_services_tpu.ops.decode_backend`):
+    paged caches route to the page-table-walking Pallas kernel, dense
+    flash-eligible caches to the flat/stacked split-K kernel, and
+    everything else to the reference dense path -- no try/except, no
+    paged dead-end raise (ISSUE 11).  'auto' silently keeps dense for a
+    distributed cache; explicit 'flash' raises there rather than
+    compiling a per-layer all-gather of the whole cache."""
+    distributed = _cache_distributed(cache)
+    if c.decode_attention == "flash" and distributed:
+        raise ValueError(
+            "decode_attention='flash' needs the KV cache resident "
+            "on one device (pallas_call has no GSPMD partitioning "
+            "rules; a tp/dp-sharded cache would be all-gathered in "
+            "full every layer).  Use 'dense' -- or 'auto', which "
+            "falls back -- when serving with a sharded cache.")
+    paged = is_paged(cache)
+    backend = decode_backend(
+        c.decode_attention, paged=paged, extent=cache_extent(cache),
+        threshold=c.flash_decode_threshold, distributed=distributed,
+        page_tokens=pool_page_tokens(cache) if paged else None)
+    return backend != "reference"
 
 
 def _scatter_positions(config: LlamaConfig, cache: dict, k_tokens,
@@ -834,14 +901,13 @@ def _decode_step_impl(params: dict, config: LlamaConfig,
     extent = cache_extent(cache)
     if use_flash is None:
         # In-jit callers (decode_block's scan, bench loops) have no
-        # sharding to inspect; resolve on extent alone, as before.  The
-        # stacked kernel needs a block-aligned cache extent (it never
-        # pads -- padding a stacked cache would copy it); "auto" quietly
-        # keeps dense for exotic extents, explicit "flash" raises there.
-        use_flash = not paged and (c.decode_attention == "flash" or (
-            c.decode_attention == "auto"
-            and extent >= c.flash_decode_threshold
-            and extent % 128 == 0))
+        # sharding to inspect; resolve on static structure alone
+        # through the same ops capability probe the eager path uses.
+        use_flash = decode_backend(
+            c.decode_attention, paged=paged, extent=extent,
+            threshold=c.flash_decode_threshold,
+            page_tokens=pool_page_tokens(cache) if paged else None) \
+            != "reference"
 
     def scatter_tokens(updates):
         # One dynamic_update_slice per batch row, unrolled.  A single
@@ -861,7 +927,9 @@ def _decode_step_impl(params: dict, config: LlamaConfig,
         # Split-K Pallas kernel path (ops/pallas_decode.py): the cache
         # streams once, no [B, H, T] HBM intermediates, int8 dequantized
         # in-kernel.  The layer scan carries the LAYER INDEX and the
-        # kernel indexes the STACKED FLAT cache in its BlockSpecs --
+        # kernel indexes the STACKED FLAT cache (or the paged page
+        # POOLS, walking the [B, pps] table inside the grid -- no
+        # host-side gather_layer materialization) in its BlockSpecs --
         # putting the cache in scan xs would materialize a per-layer
         # slice copy ahead of the pallas call (XLA fuses slices into
         # einsums but not into custom calls; measured ~0.3 ms/layer at
@@ -869,10 +937,15 @@ def _decode_step_impl(params: dict, config: LlamaConfig,
         # what keeps the kernel's operand at the default layout -- see
         # its docstring for the 2x full-cache copies a grouped buffer
         # cost.
-        from ..ops.pallas_decode import (_split_stacked,
+        from ..ops.pallas_decode import (_split_paged, _split_stacked,
+                                         flash_decode_append_paged,
                                          flash_decode_append_stacked)
-        k_view = _split_stacked(cache["k"])
-        v_view = _split_stacked(cache["v"])
+        if paged:
+            k_view = _split_paged(cache["k"])
+            v_view = _split_paged(cache["v"])
+        else:
+            k_view = _split_stacked(cache["k"])
+            v_view = _split_stacked(cache["v"])
         hidden0 = params["embed"][tokens][:, None, :]
 
         def layer_step(carry, xs):
@@ -883,6 +956,10 @@ def _decode_step_impl(params: dict, config: LlamaConfig,
                 q = apply_rope(q, rope_table, positions)
                 k = apply_rope(k, rope_table, positions)
                 kv_write.updated = (k, v)
+                if paged:
+                    return flash_decode_append_paged(
+                        q, k_view, v_view, index, k, v,
+                        cache["page_table"], lengths)
                 return flash_decode_append_stacked(
                     q, k_view, v_view, index, k, v, lengths)
             hidden2, aux2 = _block(c, hidden, layer, kv_write)
@@ -924,13 +1001,44 @@ _decode_step_jit = partial(jax.jit, static_argnames=("config", "use_flash"),
                            donate_argnames=("cache",))(_decode_step_impl)
 
 
+def _distributed_array(arr) -> bool:
+    """Concrete array resident sharded across more than one device
+    (tracers carry no sharding and resolve as resident)."""
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return (len(sharding.device_set) > 1
+                and not sharding.is_fully_replicated)
+    except (AttributeError, TypeError):
+        return False
+
+
+def _matmul_safe_config(c: LlamaConfig, params: dict) -> LlamaConfig:
+    """The decode gate's pallas_call-has-no-GSPMD invariant applied to
+    the matmul kernel: a DISTRIBUTED quantized unembed (TP/fsdp
+    serving) must keep XLA's cast-into-dot path -- jit would otherwise
+    all-gather the largest weight every step.  Resolved eagerly in the
+    serving wrappers (and ContinuousBatcher), where the concrete
+    tree's sharding is visible; inside jit the leaves are tracers and
+    cannot be inspected."""
+    if matmul_backend(c.matmul_kernel) == "reference":
+        return c
+    unembed = params.get("unembed") if isinstance(params, dict) else None
+    if is_quantized(unembed) and _distributed_array(unembed["int8"]):
+        return dataclasses.replace(c, matmul_kernel="off")
+    return c
+
+
 def decode_step(params: dict, config: LlamaConfig, tokens: jax.Array,
                 cache: dict, lengths: jax.Array) \
         -> tuple[jax.Array, dict]:
     """One decode token per active sequence (see _decode_step_impl).
     The flash-vs-dense choice resolves HERE, where the concrete cache's
     sharding is visible -- 'auto' never routes a tp/dp-sharded cache
-    into the partitioning-rule-less Pallas kernel."""
+    (or a tp/fsdp-sharded quantized unembed, via _matmul_safe_config)
+    into the partitioning-rule-less Pallas kernels."""
+    config = _matmul_safe_config(config, params)
     return _decode_step_jit(params, config, tokens, cache, lengths,
                             use_flash=_resolve_decode_flash(config, cache))
 
@@ -949,23 +1057,39 @@ def temperature_sample(key: jax.Array, logits: jax.Array,
 
 
 def select_tokens(key: jax.Array, logits: jax.Array,
-                  temperatures: jax.Array) -> jax.Array:
+                  temperatures: jax.Array,
+                  top_k: int = 0) -> jax.Array:
     """Per-row sampling in one draw: rows with temperature 0 take the
     argmax, rows with temperature > 0 a categorical sample at their own
-    temperature."""
+    temperature.  ``top_k`` > 0 (static) restricts the categorical to
+    the k highest logits via the ops top-k interface -- the Pallas
+    kernel (ops/pallas_topk.py) on TPU, ``lax.top_k`` elsewhere; the
+    candidate set is found in one cache-friendly pass instead of a
+    full-vocab sort, and greedy rows are unaffected (argmax == top-1).
+    """
     greedy = jnp.argmax(logits, axis=-1)
     safe = jnp.maximum(temperatures, 0.05)[:, None]
-    sampled = jax.random.categorical(
-        key, logits.astype(jnp.float32) / safe, axis=-1)
+    if top_k:
+        from ..ops import topk as ops_topk
+        values, indices = ops_topk(logits.astype(jnp.float32),
+                                   int(top_k))
+        choice = jax.random.categorical(key, values / safe, axis=-1)
+        sampled = jnp.take_along_axis(indices, choice[:, None],
+                                      axis=1)[:, 0]
+    else:
+        sampled = jax.random.categorical(
+            key, logits.astype(jnp.float32) / safe, axis=-1)
     return jnp.where(temperatures > 0, sampled, greedy)
 
 
-@partial(jax.jit, static_argnames=("config", "num_steps", "use_flash"),
+@partial(jax.jit, static_argnames=("config", "num_steps", "use_flash",
+                                   "top_k"),
          donate_argnames=("cache",))
 def _decode_block_jit(params: dict, config: LlamaConfig, tokens: jax.Array,
                       cache: dict, lengths: jax.Array, active: jax.Array,
                       temperatures: jax.Array, key: jax.Array, *,
-                      num_steps: int, use_flash: bool) \
+                      num_steps: int, use_flash: bool,
+                      top_k: int = 0) \
         -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, dict]:
     """``num_steps`` decode iterations fused into ONE dispatch
     (sampling included), amortizing the host round trip -- through a
@@ -995,8 +1119,8 @@ def _decode_block_jit(params: dict, config: LlamaConfig, tokens: jax.Array,
                                           cache, positions,
                                           use_flash=use_flash)
         key, sub = jax.random.split(key)
-        tokens = select_tokens(sub, logits, temperatures).astype(
-            jnp.int32)
+        tokens = select_tokens(sub, logits, temperatures,
+                               top_k=top_k).astype(jnp.int32)
         lengths = lengths + active.astype(lengths.dtype)
         return (tokens, cache, lengths, key), tokens
 
@@ -1008,14 +1132,15 @@ def _decode_block_jit(params: dict, config: LlamaConfig, tokens: jax.Array,
 def decode_block(params: dict, config: LlamaConfig, tokens: jax.Array,
                  cache: dict, lengths: jax.Array, active: jax.Array,
                  temperatures: jax.Array, key: jax.Array, *,
-                 num_steps: int) \
+                 num_steps: int, top_k: int = 0) \
         -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, dict]:
     """num_steps fused decode iterations (see _decode_block_jit); the
     flash-vs-dense choice resolves here on the concrete cache's
     sharding, exactly as in :func:`decode_step`."""
+    config = _matmul_safe_config(config, params)
     return _decode_block_jit(params, config, tokens, cache, lengths,
                              active, temperatures, key,
-                             num_steps=num_steps,
+                             num_steps=num_steps, top_k=int(top_k),
                              use_flash=_resolve_decode_flash(config, cache))
 
 
@@ -1065,7 +1190,7 @@ def _history_push(history, candidates, cut):
 
 
 def _chunk_verify(params, config: LlamaConfig, chunk, cache, starts,
-                  trash: int):
+                  trash: int, use_flash: bool = False):
     """One batched multi-token target step: forward ``chunk`` [B, S]
     (current token + S-1 draft tokens per row) at per-row positions
     ``starts + i``, writing every position's KV optimistically and
@@ -1078,7 +1203,15 @@ def _chunk_verify(params, config: LlamaConfig, chunk, cache, starts,
     decode overwrites before exposing -- the same overshoot contract
     the fused block path established.  Positions clamp to the trash
     position at the cache boundary (rows there stop this iteration,
-    and their clamped-position tokens are cut before emission)."""
+    and their clamped-position tokens are cut before emission).
+
+    ``use_flash`` routes the concat-attention through the batched
+    chunk-verify kernel (ops/pallas_decode.py:flash_verify_append,
+    ISSUE 11): the cache streams ONCE for all S positions with no
+    [B, H, S, T] HBM logits -- and paged caches walk the page table
+    in-kernel instead of paying the per-layer gather.  int8 caches
+    dequantize in-kernel (exact), so the dense path's gather-and-
+    dequantize trick is no longer the only option."""
     c = config
     b, s = chunk.shape
     rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
@@ -1086,6 +1219,40 @@ def _chunk_verify(params, config: LlamaConfig, chunk, cache, starts,
                             trash)                           # [B, S]
     paged = is_paged(cache)
     extent = cache_extent(cache)
+
+    def scatter_chunk(updates):
+        k_tokens, v_tokens = updates             # [L, B, S, K, hd]
+        return _scatter_positions(c, cache, k_tokens, v_tokens,
+                                  positions)
+
+    if use_flash:
+        from ..ops.pallas_decode import (_split_paged, _split_stacked,
+                                         flash_verify_append)
+        if paged:
+            k_view = _split_paged(cache["k"])
+            v_view = _split_paged(cache["v"])
+        else:
+            k_view = _split_stacked(cache["k"])
+            v_view = _split_stacked(cache["v"])
+
+        def layer_step(carry, xs):
+            hidden, aux = carry
+            layer, index = xs
+
+            def kv_write(q, k, v):
+                q = apply_rope(q, rope_table, positions)
+                k = apply_rope(k, rope_table, positions)
+                kv_write.updated = (k, v)
+                return flash_verify_append(
+                    q, k_view, v_view, index, k, v, starts, positions,
+                    page_table=cache["page_table"] if paged else None)
+            hidden2, aux2 = _block(c, hidden, layer, kv_write)
+            return (hidden2, aux + aux2), kv_write.updated
+
+        (hidden, _), updates = jax.lax.scan(
+            layer_step, (params["embed"][chunk], jnp.float32(0.0)),
+            (params["layers"], jnp.arange(c.n_layers)))
+        return _finish(params, c, hidden), scatter_chunk(updates)
 
     def factory(k_layer, v_layer):
         def kv_write(q, k, v):
@@ -1119,11 +1286,6 @@ def _chunk_verify(params, config: LlamaConfig, chunk, cache, starts,
                                      kv_positions=kv_positions)
         return kv_write
 
-    def scatter_chunk(updates):
-        k_tokens, v_tokens = updates             # [L, B, S, K, hd]
-        return _scatter_positions(c, cache, k_tokens, v_tokens,
-                                  positions)
-
     logits, new_cache, _ = _forward_layers(
         params, c, params["embed"][chunk], cache, factory,
         cache_from_updates=scatter_chunk)
@@ -1132,7 +1294,7 @@ def _chunk_verify(params, config: LlamaConfig, chunk, cache, starts,
 
 @partial(jax.jit,
          static_argnames=("config", "ring", "speculative", "spec_tokens",
-                          "use_flash"),
+                          "use_flash", "top_k"),
          donate_argnames=("cache",))
 def _decode_loop_jit(params: dict, draft: dict, config: LlamaConfig,
                      tokens: jax.Array, cache: dict, lengths: jax.Array,
@@ -1140,7 +1302,7 @@ def _decode_loop_jit(params: dict, draft: dict, config: LlamaConfig,
                      temperatures: jax.Array, eos: jax.Array,
                      history: jax.Array, key: jax.Array, *, ring: int,
                      speculative: str, spec_tokens: int,
-                     use_flash: bool):
+                     use_flash: bool, top_k: int = 0):
     """The device-resident serving loop: up to ``ring`` tokens per row
     generated inside ONE dispatch, with sampling, per-slot stop
     detection (EOS + budget + cache boundary) and speculative
@@ -1191,8 +1353,8 @@ def _decode_loop_jit(params: dict, draft: dict, config: LlamaConfig,
         logits, cache = _decode_step_impl(params, config, tokens, cache,
                                           positions, use_flash=use_flash)
         key, sub = jax.random.split(key)
-        sampled = select_tokens(sub, logits, temperatures).astype(
-            jnp.int32)
+        sampled = select_tokens(sub, logits, temperatures,
+                                top_k=top_k).astype(jnp.int32)
         slot_index = jnp.where(active, counts, ring)     # ring = trash col
         emitted = emitted.at[jnp.arange(b), slot_index].set(sampled)
         counts = counts + active
@@ -1229,11 +1391,12 @@ def _decode_loop_jit(params: dict, draft: dict, config: LlamaConfig,
         chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
         starts = jnp.where(active, jnp.minimum(lengths, trash), trash)
         logits, cache = _chunk_verify(params, config, chunk, cache,
-                                      starts, trash)
+                                      starts, trash,
+                                      use_flash=use_flash)
         key, sub = jax.random.split(key)
         greedy = jnp.argmax(logits, -1).astype(jnp.int32)    # [B, k+1]
-        first = select_tokens(sub, logits[:, 0, :],
-                              temperatures).astype(jnp.int32)
+        first = select_tokens(sub, logits[:, 0, :], temperatures,
+                              top_k=top_k).astype(jnp.int32)
         candidates = greedy.at[:, 0].set(first)
         # Longest matching draft prefix; sampled rows accept none (the
         # per-token distribution stays exactly the non-speculative one).
@@ -1284,18 +1447,21 @@ def decode_loop(params: dict, config: LlamaConfig, tokens: jax.Array,
                 budget: jax.Array, temperatures: jax.Array,
                 eos: jax.Array, history: jax.Array, key: jax.Array, *,
                 ring: int, speculative: str = "off",
-                spec_tokens: int = 4, draft: dict | None = None):
+                spec_tokens: int = 4, draft: dict | None = None,
+                top_k: int = 0):
     """Device-resident generation block (see _decode_loop_jit); the
     flash-vs-dense choice resolves here on the concrete cache's
     sharding/structure, exactly as in :func:`decode_step`."""
     if speculative not in ("off", "ngram", "draft"):
         raise ValueError(
             f"speculative={speculative!r}: one of off|ngram|draft")
+    config = _matmul_safe_config(config, params)
     return _decode_loop_jit(params, draft if draft is not None else params,
                             config, tokens, cache, lengths, active,
                             budget, temperatures, eos, history, key,
                             ring=int(ring), speculative=speculative,
                             spec_tokens=int(spec_tokens),
+                            top_k=int(top_k),
                             use_flash=_resolve_decode_flash(config, cache))
 
 

@@ -27,12 +27,19 @@ detect a paged cache (:func:`is_paged`) and (a) gather a slot's pages
 into the contiguous row view their attention already consumes, (b)
 scatter KV writes through the table with per-position
 ``dynamic_update_slice`` (in-place under donation, same discipline as
-the dense path).  The gather materializes the logical view, so paged
-decode streams the cache roughly twice per step on TPU -- the price of
-paging without a paged-attention kernel; the win is memory (pool sized
-to the *live* token count) and recompile-free admission.  Pallas
-flash-decode indexes the flat stacked cache directly and is therefore
-dense-only; paged configs keep dense attention.
+the dense path).  The gather materializes the logical view, so the
+REFERENCE paged decode streams the cache roughly twice per step on TPU
+-- the price of paging without a paged-attention kernel.  ISSUE 11
+removed that price on the kernel plane: when the decode backend
+resolves to ``paged-kernel`` (ops.decode_backend -- 'auto' past the
+flash threshold, or an explicit flash/``decode_kernel`` request),
+decode and chunk-verify walk the page table IN-KERNEL
+(ops/pallas_decode.py:flash_decode_attention_paged): the BlockSpec
+index maps resolve each slot's physical pages from the scalar-
+prefetched table, so the logical row view never materializes and the
+cache streams once.  The gather path remains the reference (and the
+sub-threshold / distributed fallback); the memory win (pool sized to
+the *live* token count) and recompile-free admission hold on both.
 """
 
 from __future__ import annotations

@@ -1,6 +1,87 @@
+"""Op-level interfaces: the transformer building blocks (ops.layers)
+plus the Pallas kernel plane behind CAPABILITY PROBES (ISSUE 11).
+
+The kernel modules (pallas_attention, pallas_decode, pallas_matmul,
+pallas_topk) are imported lazily at first use so the package import
+never pays for jax.experimental.pallas; callers select an
+implementation through the probes below instead of try/except around a
+kernel that raises -- ``decode_backend`` replaced exactly such a
+dead-end (flash-decode used to raise on paged caches).
+"""
+
+import jax
+
 from .layers import (rms_norm, rope_frequencies, apply_rope, swiglu,
                      repeat_kv, attention_prefill, attention_decode,
                      attention_decode_append)
-# ops.pallas_attention / ops.pallas_decode are imported lazily at first
-# use (llama.decode_step, prefill_into_slot) so the package import does
-# not pay for jax.experimental.pallas; import them by module path.
+
+__all__ = ["rms_norm", "rope_frequencies", "apply_rope", "swiglu",
+           "repeat_kv", "attention_prefill", "attention_decode",
+           "attention_decode_append", "decode_backend",
+           "matmul_backend", "topk", "DECODE_BACKENDS"]
+
+#: every value :func:`decode_backend` can return, in preference order.
+DECODE_BACKENDS = ("paged-kernel", "dense-flash", "reference")
+
+
+def decode_backend(requested: str = "auto", *, paged: bool = False,
+                   extent: int | None = None, threshold: int = 1024,
+                   distributed: bool = False,
+                   page_tokens: int | None = None) -> str:
+    """Capability probe for decode attention: which implementation
+    serves a cache of this structure -- ``paged-kernel`` (the
+    page-table-walking split-K Pallas kernel, ops/pallas_decode.py),
+    ``dense-flash`` (the flat/stacked split-K kernel) or ``reference``
+    (the dense einsum path, ops/layers.py).
+
+    ``requested`` is the config's ``decode_attention``
+    (dense|flash|auto); ``distributed`` forces the reference path
+    (pallas_call has no GSPMD partitioning rules -- the caller decides
+    whether an explicit 'flash' request on a sharded cache is an
+    error); under ``auto`` the kernels engage once ``extent`` reaches
+    ``threshold`` and the structure fits (dense: block-alignable
+    extent; paged: sublane-aligned ``page_tokens``).  Pure and
+    jax-free-cheap, so in-jit callers can resolve on static structure.
+    """
+    if requested in ("dense", "reference") or distributed:
+        return "reference"
+    if paged:
+        if requested == "flash":
+            return "paged-kernel"
+        if (extent or 0) >= threshold and page_tokens \
+                and page_tokens % 8 == 0:
+            return "paged-kernel"
+        return "reference"
+    if requested == "flash":
+        return "dense-flash"
+    if (extent or 0) >= threshold and (extent or 0) % 128 == 0:
+        return "dense-flash"
+    return "reference"
+
+
+def matmul_backend(requested: str = "auto") -> str:
+    """Capability probe for the fused int8 dequant-matmul
+    (ops/pallas_matmul.py): ``pallas-int8`` or ``reference`` (the
+    cast-into-the-dot XLA path).  ``auto`` engages the kernel on TPU
+    backends only -- interpret mode would trade a fused HLO pair for an
+    emulated grid loop."""
+    if requested == "pallas":
+        return "pallas-int8"
+    if requested == "auto" and jax.default_backend() == "tpu":
+        return "pallas-int8"
+    return "reference"
+
+
+def topk(x, k: int, *, kernel: bool | None = None):
+    """Top-k over the last axis: ``(values, indices)`` with
+    ``jax.lax.top_k``'s ordering contract (descending values, ties to
+    the lowest index).  ``kernel=None`` resolves to the Pallas kernel
+    (ops/pallas_topk.py) on TPU and ``lax.top_k`` elsewhere; pass
+    True/False to force (the equivalence tests force True under
+    interpret mode)."""
+    if kernel is None:
+        kernel = jax.default_backend() == "tpu"
+    if kernel:
+        from .pallas_topk import topk as pallas_topk
+        return pallas_topk(x, int(k))
+    return jax.lax.top_k(x, int(k))

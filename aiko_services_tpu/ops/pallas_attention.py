@@ -27,7 +27,16 @@ try:
 except ImportError:                               # pragma: no cover
     pltpu = None
 
+from .tiles import pad_to as _pad_to, round_up as _round_up
+
 __all__ = ["flash_attention"]
+
+#: kernel entry -> its tier-1 equivalence test (see the ``kernel-test``
+#: selfcheck rule; the test runs interpret mode on the CPU mesh).
+KERNEL_EQUIVALENCE_TESTS = {
+    "flash_attention":
+        "test_pallas_attention.py::test_flash_matches_dense",
+}
 
 _NEG_INF = -1e30
 _STAT_LANES = 128      # softmax stats replicated across the lane dim
@@ -122,20 +131,6 @@ def _flash_kernel(offset_ref, q_ref, k_ref, v_ref, o_ref,
     def _finalize():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
-
-
-def _pad_to(x, axis, multiple):
-    size = x.shape[axis]
-    pad = (-size) % multiple
-    if not pad:
-        return x
-    widths = [(0, 0)] * x.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(x, widths)
-
-
-def _round_up(n, multiple):
-    return -(-n // multiple) * multiple
 
 
 @functools.partial(jax.jit, static_argnames=(

@@ -37,6 +37,20 @@ it is the MXU-friendly formulation):
   (exactly the split the dense path uses) -- see
   :func:`flash_decode_append`.
 
+ISSUE 11 grew this module into the serving kernel PLANE: the same
+split-K body now also runs over layer-STACKED caches (scan-invariant,
+layer picked in the BlockSpecs -- no per-layer slice copy), over PAGED
+page pools (``flash_decode_attention_paged``: the [B, pps] page table
+is scalar-prefetched and walked inside the grid's index maps, so the
+logical row view the gather-attention path materialized never exists
+and the cache streams once), and under the speculative verify chunk
+(``flash_verify_append``: all S draft positions share one cache
+frontier, so the cache part is THIS kernel with S*H block-diagonal
+query rows, and the chunk's own causal keys combine outside -- the
+cache is read once per verify, not once per drafted token).  Backend
+choice lives in ``aiko_services_tpu.ops.decode_backend`` (capability
+probe, not try/except).
+
 On non-TPU backends the kernel runs in interpret mode, so tests exercise
 the identical code path on the CPU mesh (SURVEY.md section 4 strategy).
 """
@@ -55,8 +69,29 @@ try:
 except ImportError:                               # pragma: no cover
     pltpu = None
 
+from .tiles import pad_to as _pad_to, round_up as _round_up
+
 __all__ = ["flash_decode_attention", "flash_decode_append",
-           "flash_decode_attention_stacked", "flash_decode_append_stacked"]
+           "flash_decode_attention_stacked", "flash_decode_append_stacked",
+           "flash_decode_attention_paged", "flash_decode_append_paged",
+           "flash_verify_append"]
+
+#: kernel entry -> its tier-1 equivalence test (``file::test``) -- the
+#: ``kernel-test`` selfcheck rule requires every ``pl.pallas_call``
+#: entry point in this module to appear here with a test that exists,
+#: and the ``kernel-table`` rule keeps the README kernel-plane table in
+#: sync with these keys.  All referenced tests force ``interpret=True``
+#: paths on the CPU mesh, so the pairing gates PRs without TPU hardware.
+KERNEL_EQUIVALENCE_TESTS = {
+    "flash_decode_attention":
+        "test_flash_decode.py::test_flash_matches_dense_bf16_cache",
+    "flash_decode_attention_stacked":
+        "test_flash_decode.py::test_decode_step_flash_matches_dense",
+    "flash_decode_attention_paged":
+        "test_kernel_plane.py::test_paged_kernel_bitwise_matches_dense_kernel",
+    "flash_verify_append":
+        "test_kernel_plane.py::test_chunk_verify_kernel_matches_dense",
+}
 
 
 def is_quantized(leaf) -> bool:
@@ -69,25 +104,97 @@ _NEG_INF = -1e30
 _STAT_LANES = 128
 
 
-def _group_onehot(h: int, n_kv: int, dtype, groups: int | None = None):
-    """[H, K] 0/1 matrix mapping query head -> its kv head (built from
+def _group_onehot(h: int, n_kv: int, dtype, groups: int | None = None,
+                  period: int | None = None):
+    """[H, K] 0/1 matrix mapping query row -> its kv head (built from
     iotas so it also works inside the kernel).  ``groups`` is the TRUE
     queries-per-kv-head count -- it must be passed explicitly when ``h``
     is sublane-PADDED (padded rows map to no kv head: all-zero rows,
-    harmless, sliced off outside)."""
-    groups = groups or (h // n_kv)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (h, n_kv), 0) // groups
+    harmless, sliced off outside).  ``period`` handles MULTI-QUERY row
+    layouts (the verify chunk's [S*H] rows repeat the head pattern every
+    H rows): row r maps through ``(r % period) // groups``.  Padded rows
+    then DO land on a kv head -- still harmless (their queries are zero
+    and their output rows are sliced off), so ``period`` is only for
+    entry points that slice."""
+    groups = groups or ((period or h) // n_kv)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (h, n_kv), 0)
+    if period is not None:
+        rows = rows % period
+    rows = rows // groups
     cols = jax.lax.broadcasted_iota(jnp.int32, (h, n_kv), 1)
     return (rows == cols).astype(dtype)
+
+
+def _scores_block(q_blk, k_blk, ks_blk, *, n_heads, n_kv, groups, period,
+                  compute_dtype, quantized):
+    """One KV block's score matrix [H, Tb] in f32 (shared by the flat,
+    stacked and paged kernels -- the block refs are already stripped of
+    their leading unit dims)."""
+    if quantized:
+        k_blk = k_blk.astype(compute_dtype)
+    s = jax.lax.dot_general(
+        q_blk, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [H, Tb]
+    if quantized:
+        # Key scales are constant along the contracted K*hd axis
+        # (each head only reads its own kv block out of the
+        # block-diagonal product), so applying them to the scores is
+        # exact dequantization: scale_h = onehot @ ks  ([H, Tb]).
+        onehot = _group_onehot(n_heads, n_kv, jnp.float32,
+                               groups=groups, period=period)
+        s = s * jax.lax.dot_general(
+            onehot, ks_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return s
+
+
+def _online_update(m_scr, l_scr, acc_scr, s, v_blk, vs_blk, *, n_heads,
+                   n_kv, groups, period, compute_dtype, quantized,
+                   p_mask=None):
+    """Fold one block's scores into the VMEM online-softmax state
+    (running max, denominator, unnormalized accumulator)."""
+    m_prev = m_scr[:, :1]                             # [H, 1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - m_safe)                           # [H, Tb] f32
+    if p_mask is not None:
+        p = jnp.where(p_mask, p, jnp.zeros_like(p))
+    correction = jnp.exp(m_prev - m_safe)
+    # The denominator sums the UNSCALED weights (the softmax
+    # normalizer) -- value scales fold into the numerator only.
+    l_scr[...] = jnp.broadcast_to(
+        l_prev * correction
+        + jnp.sum(p, axis=1, keepdims=True, dtype=jnp.float32),
+        l_scr.shape)
+    if quantized:
+        # Value scales fold into the weights -- exact for the same
+        # constant-along-hd reason; the weights themselves stay
+        # float (NO int8 weight quantization: the dense path's
+        # diffuse-tail truncation mode does not exist here).
+        onehot = _group_onehot(n_heads, n_kv, jnp.float32,
+                               groups=groups, period=period)
+        p = p * jax.lax.dot_general(
+            onehot, vs_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        v_blk = v_blk.astype(compute_dtype)
+    pv = jax.lax.dot_general(
+        p.astype(compute_dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [H, K*hd]
+    acc_scr[...] = acc_scr[...] * correction + pv
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
 
 
 def _decode_kernel(meta_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
                    o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr, *,
                    block_t, n_heads, n_kv, groups, compute_dtype,
-                   quantized, layered):
+                   quantized, layered, period=None):
     """meta_ref: scalar-prefetch i32 array -- ``lengths`` [B] in the
-    per-layer form, ``[layer, *lengths]`` in the layered form (the cache
-    refs then carry a leading layer dim the BlockSpecs index into)."""
+    per-layer form, ``[layer, *lengths]`` in the layered/paged forms
+    (the cache refs then carry a leading layer dim the BlockSpecs index
+    into; the PAGED form additionally appends the flattened page table,
+    consumed only by the index maps -- the kernel body is identical,
+    one ``block_t``-sized stretch of the logical row per grid step)."""
     b = pl.program_id(0)
     ti = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -108,69 +215,28 @@ def _decode_kernel(meta_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
     # -- at full context that is all blocks but the last.
     interior = t_start + block_t <= length
 
-    def _scores():
-        k_blk = kv_blk(k_ref)
-        if quantized:
-            k_blk = k_blk.astype(compute_dtype)
-        s = jax.lax.dot_general(
-            q_ref[0], k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [H, Tb]
-        if quantized:
-            # Key scales are constant along the contracted K*hd axis
-            # (each head only reads its own kv block out of the
-            # block-diagonal product), so applying them to the scores is
-            # exact dequantization: scale_h = onehot @ ks  ([H, Tb]).
-            onehot = _group_onehot(n_heads, n_kv, jnp.float32,
-                                   groups=groups)
-            s = s * jax.lax.dot_general(
-                onehot, kv_blk(ks_ref), (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        return s
+    shared = dict(n_heads=n_heads, n_kv=n_kv, groups=groups,
+                  period=period, compute_dtype=compute_dtype,
+                  quantized=quantized)
 
-    def _online_update(s, p_mask=None):
-        m_prev = m_scr[:, :1]                             # [H, 1]
-        l_prev = l_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - m_safe)                           # [H, Tb] f32
-        if p_mask is not None:
-            p = jnp.where(p_mask, p, jnp.zeros_like(p))
-        correction = jnp.exp(m_prev - m_safe)
-        # The denominator sums the UNSCALED weights (the softmax
-        # normalizer) -- value scales fold into the numerator only.
-        l_scr[...] = jnp.broadcast_to(
-            l_prev * correction
-            + jnp.sum(p, axis=1, keepdims=True, dtype=jnp.float32),
-            l_scr.shape)
-        v_blk = kv_blk(v_ref)
-        if quantized:
-            # Value scales fold into the weights -- exact for the same
-            # constant-along-hd reason; the weights themselves stay
-            # float (NO int8 weight quantization: the dense path's
-            # diffuse-tail truncation mode does not exist here).
-            onehot = _group_onehot(n_heads, n_kv, jnp.float32,
-                                   groups=groups)
-            p = p * jax.lax.dot_general(
-                onehot, kv_blk(vs_ref), (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            v_blk = v_blk.astype(compute_dtype)
-        pv = jax.lax.dot_general(
-            p.astype(compute_dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [H, K*hd]
-        acc_scr[...] = acc_scr[...] * correction + pv
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    def _scores():
+        return _scores_block(q_ref[0], kv_blk(k_ref), kv_blk(ks_ref),
+                             **shared)
 
     @pl.when(jnp.logical_and(live, interior))
     def _compute_interior():
-        _online_update(_scores())
+        _online_update(m_scr, l_scr, acc_scr, _scores(), kv_blk(v_ref),
+                       kv_blk(vs_ref), **shared)
 
     @pl.when(jnp.logical_and(live, jnp.logical_not(interior)))
     def _compute_boundary():
         t_pos = t_start + jax.lax.broadcasted_iota(
             jnp.int32, (n_heads, block_t), 1)
         mask = t_pos < length
-        _online_update(jnp.where(mask, _scores(), _NEG_INF),
-                       p_mask=mask)
+        _online_update(m_scr, l_scr, acc_scr,
+                       jnp.where(mask, _scores(), _NEG_INF),
+                       kv_blk(v_ref), kv_blk(vs_ref), p_mask=mask,
+                       **shared)
 
     @pl.when(ti == nt - 1)
     def _finalize():
@@ -179,18 +245,40 @@ def _decode_kernel(meta_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
         l_ref[0] = l_scr[...]
 
 
-def _pad_to(x, axis, multiple):
-    size = x.shape[axis]
-    pad = (-size) % multiple
-    if not pad:
-        return x
-    widths = [(0, 0)] * x.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(x, widths)
+def _fit_block(t: int, block_t: int, *, pad: bool, entry: str) -> int:
+    """Resolve the usable time-block size for a cache extent ``t`` --
+    the ONE extent check shared by every decode entry point.
+    ``pad=True`` (flat per-batch caches) just clamps: the caller pads
+    its operands to a block multiple, a copy of only the small per-call
+    views.  ``pad=False`` (stacked/paged pools, which are NEVER padded
+    -- that copy would be the whole cache) shrinks block_t to a divisor
+    of t and raises when none >= 128 exists."""
+    block_t = min(block_t, _round_up(max(t, 8), 8))
+    if pad:
+        return block_t
+    while t % block_t and block_t > 128:
+        block_t //= 2
+    if t % block_t:
+        # Callers gate on t % 128 == 0 (llama decode falls back to
+        # dense); reaching here means an explicit misuse.
+        raise ValueError(
+            f"{entry}: cache extent {t} has no block-aligned divisor "
+            f">= 128 (use a multiple of 128, or the dense/per-layer "
+            f"path)")
+    return block_t
 
 
-def _round_up(n, multiple):
-    return -(-n // multiple) * multiple
+def _require_matched_quantization(k_quantized: bool, v_quantized: bool,
+                                  entry: str) -> None:
+    """init_cache/init_paged_cache quantize k and v together; a mixed
+    pair can only come from caller error, and the kernels key their
+    in-kernel dequant on the K scales alone -- a raw v would be read as
+    int8 garbage.  The shared invariant check of every append entry."""
+    if k_quantized != v_quantized:
+        raise ValueError(
+            f"{entry}: k and v caches must share one quantization "
+            f"state (both int8 layers or both raw arrays); got "
+            f"k quantized={k_quantized}, v quantized={v_quantized}")
 
 
 @functools.partial(jax.jit, static_argnames=("block_t", "interpret"))
@@ -216,7 +304,8 @@ def flash_decode_attention(q_pad, k_flat, v_flat, k_scale_t, v_scale_t,
 
     h_pad = _round_up(max(h, 8), 8)
     q_pad = _pad_to(q_pad, 1, h_pad)
-    block_t = min(block_t, _round_up(max(t, 8), 8))
+    block_t = _fit_block(t, block_t, pad=True,
+                         entry="flash_decode_attention")
     k_flat = _pad_to(k_flat, 1, block_t)
     v_flat = _pad_to(v_flat, 1, block_t)
     t_pad = k_flat.shape[1]
@@ -293,11 +382,13 @@ def flash_decode_attention(q_pad, k_flat, v_flat, k_scale_t, v_scale_t,
     return acc[:, :h], m[:, :h, 0], l[:, :h, 0]
 
 
-@functools.partial(jax.jit, static_argnames=("block_t", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block_t", "interpret",
+                                             "qrow_period"))
 def flash_decode_attention_stacked(q_pad, k_flat, v_flat, k_scale_t,
                                    v_scale_t, layer, lengths, *,
                                    block_t: int = 2048,
-                                   interpret: bool | None = None):
+                                   interpret: bool | None = None,
+                                   qrow_period: int | None = None):
     """:func:`flash_decode_attention` over ONE layer of a STACKED cache.
 
     k_flat/v_flat: [L, B, T, C] -- the whole layer-stacked cache, passed
@@ -310,8 +401,9 @@ def flash_decode_attention_stacked(q_pad, k_flat, v_flat, k_scale_t,
     on v5e, which erased the kernel's win.  Indexing the layer inside
     the grid spec reads the cache in place.  k_scale_t/v_scale_t:
     [L, B, K, T] f32 or None; lengths: [B].  T must be a multiple of
-    block_t (block_t is shrunk to a divisor by the caller -- padding a
-    stacked cache would copy it).
+    block_t (block_t is shrunk to a divisor by the shared extent check
+    -- padding a stacked cache would copy it).  ``qrow_period``: see
+    :func:`flash_verify_append` (the [S*H]-row multi-query layout).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -322,16 +414,8 @@ def flash_decode_attention_stacked(q_pad, k_flat, v_flat, k_scale_t,
 
     h_pad = _round_up(max(h, 8), 8)
     q_pad = _pad_to(q_pad, 1, h_pad)
-    block_t = min(block_t, _round_up(max(t, 8), 8))
-    while t % block_t and block_t > 128:   # never pad a stacked cache
-        block_t //= 2
-    if t % block_t:
-        # Callers gate on t % 128 == 0 (llama decode_step falls back to
-        # dense); reaching here means an explicit misuse.
-        raise ValueError(
-            f"flash_decode_attention_stacked: cache extent {t} has no "
-            f"block-aligned divisor >= 128 (use a multiple of 128, or "
-            f"the dense/per-layer path)")
+    block_t = _fit_block(t, block_t, pad=False,
+                         entry="flash_decode_attention_stacked")
     if not quantized:
         n_kv = 1
         k_scale_t = jnp.zeros((1, b, 1, t), dtype=jnp.float32)
@@ -356,8 +440,9 @@ def flash_decode_attention_stacked(q_pad, k_flat, v_flat, k_scale_t,
 
     kernel = functools.partial(
         _decode_kernel, block_t=block_t, n_heads=h_pad, n_kv=n_kv,
-        groups=max(h // n_kv, 1), compute_dtype=compute_dtype,
-        quantized=quantized, layered=True)
+        groups=max((qrow_period or h) // n_kv, 1),
+        compute_dtype=compute_dtype,
+        quantized=quantized, layered=True, period=qrow_period)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -398,6 +483,145 @@ def flash_decode_attention_stacked(q_pad, k_flat, v_flat, k_scale_t,
     return acc[:, :h], m[:, :h, 0], l[:, :h, 0]
 
 
+@functools.partial(jax.jit, static_argnames=("interpret", "qrow_period"))
+def flash_decode_attention_paged(q_pad, k_pool, v_pool, k_scale_t,
+                                 v_scale_t, layer, page_table, lengths,
+                                 *, interpret: bool | None = None,
+                                 qrow_period: int | None = None):
+    """:func:`flash_decode_attention` over ONE layer of a PAGED cache
+    pool, the page table walked IN-KERNEL (ISSUE 11 tentpole).
+
+    k_pool/v_pool: [L, P, pt, C] physical page pools (models/paged.py
+    layout, layer-stacked and scan-invariant -- the same no-per-layer-
+    slice discipline as the stacked kernel); k_scale_t/v_scale_t:
+    [L, P, K, pt] f32 per-page scale pools or None (int8 pools,
+    dequantized in-kernel exactly like the flat kernel); ``layer``:
+    traced scalar; page_table: [B, pps] int32 (entry 0 = the reserved
+    trash page); lengths: [B] valid positions.
+
+    The grid is (B, pages_per_slot): each step's BlockSpec resolves its
+    PHYSICAL page from the scalar-prefetched table --
+    ``table[b, min(pi, last_live)]`` -- so the pool is read in place,
+    one page DMA per live logical page.  No host-side ``gather_layer``
+    materialization: the logical [B, T, C] row view never exists, which
+    is exactly the 2x cache traffic the gather-attention paged path
+    paid.  Blocks past a row's length clamp to its last live page
+    (compute skipped via pl.when, the repeated index skips the DMA), so
+    a short slot reads only its own extent.  Returns the same partial
+    (acc, m, l) stats as the flat kernel.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    quantized = k_scale_t is not None
+    b, h, c = q_pad.shape
+    page_tokens = k_pool.shape[2]
+    if page_tokens % 8:
+        # Pages ARE the kernel's time blocks and the pool is never
+        # padded (the stacked-cache discipline): a sublane-misaligned
+        # page size would surface as an opaque Mosaic tiling error on
+        # TPU, so refuse it by name on every backend -- the 'auto'
+        # probe (ops.decode_backend) already steers such configs to
+        # the reference path; only a forced request can reach here.
+        raise ValueError(
+            f"flash_decode_attention_paged: kv_page_tokens="
+            f"{page_tokens} must be a multiple of 8 (one sublane "
+            f"tile); use an aligned page size or the reference "
+            f"gather path")
+    pps = page_table.shape[1]
+    n_kv = k_scale_t.shape[2] if quantized else None
+
+    h_pad = _round_up(max(h, 8), 8)
+    q_pad = _pad_to(q_pad, 1, h_pad)
+    if not quantized:
+        n_kv = 1
+        k_scale_t = jnp.zeros((1, 1, 1, page_tokens), dtype=jnp.float32)
+        v_scale_t = jnp.zeros((1, 1, 1, page_tokens), dtype=jnp.float32)
+
+    grid = (b, pps)
+    compute_dtype = q_pad.dtype if q_pad.dtype != jnp.float32 \
+        else jnp.float32
+    scale_layers = k_pool.shape[0] if quantized else 1
+    scale_pages = k_scale_t.shape[1]
+
+    def _physical(bi, pi, meta):
+        # meta = [layer, lengths[B], table.ravel()[B*pps]].  Clamp dead
+        # logical pages to the row's last live one (pl.when skips the
+        # compute, the repeated physical index skips the DMA), then
+        # translate logical -> physical through the prefetched table.
+        last_live = jnp.maximum(
+            pl.cdiv(meta[1 + bi], page_tokens) - 1, 0)
+        logical = jnp.minimum(pi, last_live)
+        return meta[1 + b + bi * pps + logical]
+
+    def kv_block(bi, pi, meta):
+        return (meta[0], _physical(bi, pi, meta), 0, 0)
+
+    def scale_block(bi, pi, meta):
+        # Unquantized pools pass a [1, 1, 1, pt] dummy: clamp both the
+        # layer and the page index so the spec never reads past it.
+        return (jnp.minimum(meta[0], scale_layers - 1),
+                jnp.minimum(_physical(bi, pi, meta), scale_pages - 1),
+                0, 0)
+
+    kernel = functools.partial(
+        _decode_kernel, block_t=page_tokens, n_heads=h_pad, n_kv=n_kv,
+        groups=max((qrow_period or h) // n_kv, 1),
+        compute_dtype=compute_dtype,
+        quantized=quantized, layered=True, period=qrow_period)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, h_pad, c), lambda bi, pi, meta: (bi, 0, 0)),
+            pl.BlockSpec((1, 1, page_tokens, c), kv_block),
+            pl.BlockSpec((1, 1, page_tokens, c), kv_block),
+            pl.BlockSpec((1, 1, n_kv, page_tokens), scale_block),
+            pl.BlockSpec((1, 1, n_kv, page_tokens), scale_block),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h_pad, c), lambda bi, pi, meta: (bi, 0, 0)),
+            pl.BlockSpec((1, h_pad, _STAT_LANES),
+                         lambda bi, pi, meta: (bi, 0, 0)),
+            pl.BlockSpec((1, h_pad, _STAT_LANES),
+                         lambda bi, pi, meta: (bi, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h_pad, c), jnp.float32),
+        ],
+    )
+    meta = jnp.concatenate([
+        jnp.asarray(layer, dtype=jnp.int32).reshape(1),
+        jnp.asarray(lengths, dtype=jnp.int32),
+        jnp.asarray(page_table, dtype=jnp.int32).reshape(-1)])
+    # Scale pools ride as [L, P, K, pt] so the kernel's [K, Tb] block
+    # matches the flat kernel's layout exactly.
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h_pad, c), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_pad, _STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_pad, _STAT_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(meta, q_pad, k_pool, v_pool, k_scale_t, v_scale_t)
+    return acc[:, :h], m[:, :h, 0], l[:, :h, 0]
+
+
+def _split_paged(side):
+    """One paged pool side (models/paged.py layout) -> ([L, P, pt, C]
+    payload, [L, P, K, pt] f32 scales or None).  Payloads are stored
+    flat already; the scale transpose is a real copy, but of the small
+    f32 scale pool, once per step -- the stacked-cache discipline."""
+    if is_quantized(side):
+        return side["int8"], side["scale"][..., 0] \
+            .transpose(0, 1, 3, 2).astype(jnp.float32)
+    return side, None
+
+
 def _split_stacked(cache):
     """Stacked cache tree -> ([L, B, T, C] payload, [L, B, K, T] f32
     scales or None).  Payloads are stored flat already (llama
@@ -416,11 +640,16 @@ def _split_stacked(cache):
     return payload, scale
 
 
-def _prep_query(q_flat, h: int, kv: int, d: int):
-    """Scaled block-diagonal queries + (blocks, onehot) head maps."""
+def _prep_query(q_flat, h: int, kv: int, d: int,
+                period: int | None = None):
+    """Scaled block-diagonal queries + (blocks, onehot) head maps.
+    ``period`` maps multi-query row layouts ([S*H] verify rows) onto
+    the repeating head pattern -- see :func:`_group_onehot`."""
     scale = d ** -0.5
-    blocks = jnp.arange(h) // (h // kv)                   # [H] kv head
-    onehot = _group_onehot(h, kv, q_flat.dtype)           # [H, K]
+    blocks = (jnp.arange(h) % (period or h)) \
+        // ((period or h) // kv)                          # [H] kv head
+    onehot = _group_onehot(h, kv, q_flat.dtype,
+                           period=period)                 # [H, K]
     # Fold the softmax scale into the padded queries -- lossless when
     # d**-0.5 is a power of two (d = 64), otherwise folded in f32 and
     # rounded once (same rounding the dense path's f32 product takes).
@@ -475,15 +704,9 @@ def flash_decode_append(q, k_cache, v_cache, k_new, v_new, lengths, *,
     function a scan slice materializes a per-layer cache copy.
     """
     b, _, h, d = q.shape
-    if is_quantized(k_cache) != is_quantized(v_cache):
-        # init_cache quantizes k and v together; a mixed pair can only
-        # come from caller error, and the kernel keys its dequant on the
-        # k scales alone -- a raw v would be read as int8 garbage.
-        raise ValueError(
-            "flash_decode_append: k_cache and v_cache must share one "
-            "quantization state (both int8 layers or both raw arrays); "
-            f"got k quantized={is_quantized(k_cache)}, "
-            f"v quantized={is_quantized(v_cache)}")
+    _require_matched_quantization(is_quantized(k_cache),
+                                  is_quantized(v_cache),
+                                  "flash_decode_append")
     if is_quantized(k_cache):
         k_payload = k_cache["int8"]
         k_scale_t = k_cache["scale"][..., 0].transpose(0, 2, 1) \
@@ -523,14 +746,9 @@ def flash_decode_append_stacked(q, k_view, v_view, layer, k_new, v_new,
     b, _, h, d = q.shape
     k_payload, k_scale_t = k_view
     v_payload, v_scale_t = v_view
-    if (k_scale_t is None) != (v_scale_t is None):
-        # Same invariant as flash_decode_append: the kernel keys its
-        # in-kernel dequant on the k scales alone.
-        raise ValueError(
-            "flash_decode_append_stacked: k and v views must share one "
-            "quantization state (init_cache quantizes them together); "
-            f"got k quantized={k_scale_t is not None}, "
-            f"v quantized={v_scale_t is not None}")
+    _require_matched_quantization(k_scale_t is not None,
+                                  v_scale_t is not None,
+                                  "flash_decode_append_stacked")
     kv = k_payload.shape[3] // d
 
     q_flat = q[:, 0]
@@ -541,3 +759,117 @@ def flash_decode_append_stacked(q, k_view, v_view, layer, k_new, v_new,
     out = _combine_self(acc, m, l, q_flat, k_new, v_new, blocks,
                         onehot, scale, kv, d)
     return out.reshape(q.shape).astype(q.dtype)
+
+
+def flash_decode_append_paged(q, k_view, v_view, layer, k_new, v_new,
+                              page_table, lengths, *,
+                              interpret: bool | None = None):
+    """Paged twin of :func:`flash_decode_append_stacked`: the cache
+    stays its PHYSICAL page pools ([L, P, pt, C] payload views +
+    [L, P, K, pt] scales from :func:`_split_paged`, scan-invariant) and
+    the kernel resolves each slot's pages from the [B, pps] table
+    inside the grid -- no host-side gather, no logical-row
+    materialization.  The stacked-cache invariant differs here: the
+    POOL extent never has to divide a block size (pages ARE the
+    blocks), but the table must cover the logical extent the lengths
+    claim -- the allocator's ``ensure`` contract.  q/k_new/v_new/
+    lengths as in flash_decode_append."""
+    b, _, h, d = q.shape
+    k_payload, k_scale_t = k_view
+    v_payload, v_scale_t = v_view
+    _require_matched_quantization(k_scale_t is not None,
+                                  v_scale_t is not None,
+                                  "flash_decode_append_paged")
+    kv = k_payload.shape[3] // d
+
+    q_flat = q[:, 0]
+    q_pad, blocks, onehot, scale = _prep_query(q_flat, h, kv, d)
+    acc, m, l = flash_decode_attention_paged(
+        q_pad, k_payload, v_payload, k_scale_t, v_scale_t, layer,
+        page_table, lengths, interpret=interpret)
+    out = _combine_self(acc, m, l, q_flat, k_new, v_new, blocks,
+                        onehot, scale, kv, d)
+    return out.reshape(q.shape).astype(q.dtype)
+
+
+def _combine_chunk(acc, m, l, q, k_new, v_new, positions, scale,
+                   kv: int, d: int):
+    """Merge the verify chunk's own keys/values (the causal self part)
+    with the kernel's cache-part stats -- the S-query generalization of
+    :func:`_combine_self`.  acc [B, S*H, C], m/l [B, S*H]; q [B,S,H,hd]
+    rope'd unscaled queries; k_new/v_new [B,S,K,hd]; positions [B,S]
+    trash-clamped absolute positions (causality among chunk keys is
+    ``key_pos <= query_pos``, exactly the dense concat path's mask).
+    Returns [B, S, H, hd] f32."""
+    b, s, h, _ = q.shape
+    blocks = jnp.arange(h) // (h // kv)
+    onehot = _group_onehot(h, kv, jnp.float32)               # [H, K]
+    k_new_h = k_new[:, :, blocks, :].astype(jnp.float32)     # [B,S,H,hd]
+    v_new_h = v_new[:, :, blocks, :].astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+    chunk_logits = jnp.einsum("bshd,bthd->bsht", q32,
+                              k_new_h) * scale               # [B,S,H,S]
+    causal = positions[:, None, None, :] <= \
+        positions[:, :, None, None]                          # [B,S,1,S]
+    chunk_logits = jnp.where(causal, chunk_logits, _NEG_INF)
+    m_k = m.reshape(b, s, h)
+    l_k = l.reshape(b, s, h)
+    m_joint = jnp.maximum(m_k, chunk_logits.max(-1))
+    correction = jnp.where(m_k <= _NEG_INF / 2, 0.0,
+                           jnp.exp(m_k - m_joint))           # [B,S,H]
+    weights = jnp.where(causal,
+                        jnp.exp(chunk_logits - m_joint[..., None]), 0.0)
+    denominator = l_k * correction + weights.sum(-1)
+    cache_part = jnp.einsum("bshkd,hk->bshd",
+                            acc.reshape(b, s, h, kv, d), onehot)
+    chunk_part = jnp.einsum("bsht,bthd->bshd", weights, v_new_h)
+    return (cache_part * correction[..., None] + chunk_part) \
+        / denominator[..., None]
+
+
+def flash_verify_append(q, k_view, v_view, layer, k_new, v_new, starts,
+                        positions, *, page_table=None,
+                        block_t: int = 2048,
+                        interpret: bool | None = None):
+    """Batched chunk-verify attention on the split-K kernels (ISSUE 11):
+    the speculative multi-token target step's concat-attention with the
+    cache read ONCE for all S draft positions -- not once per drafted
+    token, and with no [B, H, S, T] HBM logits.
+
+    All S queries of a row share one cache validity frontier
+    (``t < starts[b]``: chunk causality over cache rows is implied by
+    ``starts <= positions``), so the cache part IS the decode kernel
+    with ``lengths = starts`` and the row axis carrying all S*H query
+    rows block-diagonally (``qrow_period`` tiles the GQA head map
+    every H rows).  The chunk's own k/v are the self part, combined
+    outside with causal masking by the trash-clamped ``positions`` --
+    the exact semantics of the dense concat path in
+    ``models/llama.py:_chunk_verify``.
+
+    q: [B, S, H, hd] rope'd queries; k_view/v_view: stacked cache views
+    (:func:`_split_stacked`) or paged pool views (:func:`_split_paged`,
+    with ``page_table`` [B, pps]); k_new/v_new: [B, S, K, hd] the
+    chunk's rope'd k/v (not yet written); starts: [B]; positions:
+    [B, S].  Returns [B, S, H, hd] in q's dtype.
+    """
+    b, s, h, d = q.shape
+    k_payload, k_scale_t = k_view
+    v_payload, v_scale_t = v_view
+    _require_matched_quantization(k_scale_t is not None,
+                                  v_scale_t is not None,
+                                  "flash_verify_append")
+    kv = k_payload.shape[3] // d
+    q_rows = q.reshape(b, s * h, d)
+    q_pad, _, _, scale = _prep_query(q_rows, s * h, kv, d, period=h)
+    if page_table is not None:
+        acc, m, l = flash_decode_attention_paged(
+            q_pad, k_payload, v_payload, k_scale_t, v_scale_t, layer,
+            page_table, starts, interpret=interpret, qrow_period=h)
+    else:
+        acc, m, l = flash_decode_attention_stacked(
+            q_pad, k_payload, v_payload, k_scale_t, v_scale_t, layer,
+            starts, block_t=block_t, interpret=interpret,
+            qrow_period=h)
+    out = _combine_chunk(acc, m, l, q, k_new, v_new, positions, scale,
+                         kv, d)
+    return out.astype(q.dtype)

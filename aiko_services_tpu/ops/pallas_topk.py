@@ -1,0 +1,184 @@
+"""On-TPU top-k as a Pallas kernel (ISSUE 11; Vortex motivates keeping
+the retrieval primitives on-device for latency-tight serving).
+
+``lax.top_k`` lowers to a full sort on TPU -- O(V log V) over the whole
+operand with the sorted vocab written back to HBM.  Serving wants the
+k highest logits of a [B, V] row (top-k sampling, and ROADMAP item 4's
+ANN search over an HBM-resident index wants exactly the same primitive
+over similarity scores): one streaming pass, O(V * k) VPU work, nothing
+but the [B, k] result leaving the chip.
+
+Shape of the kernel: the grid is (B/8 row groups, V blocks).  Each
+step loads one [8, block_v] tile, extracts ITS top-k by k masked
+max-passes, and folds them into a running [8, k] (value, index) state
+in VMEM scratch -- one insertion per candidate against the current
+weakest entry, ordered lexicographically by (value desc, index asc) so
+ties resolve to the LOWEST index, matching ``lax.top_k``'s stable
+contract (the equivalence test pins both, ties included).  The last
+block sorts the k survivors and writes them out.  k is a static trace
+constant <= 128 (one lane tile); sampling uses k in the single digits.
+
+On non-TPU backends the kernel runs in interpret mode, so the
+equivalence tests exercise the identical code path on the CPU mesh;
+the dispatching interface (``aiko_services_tpu.ops.topk``) keeps
+``lax.top_k`` there and reserves the kernel for TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:                               # pragma: no cover
+    pltpu = None
+
+from .tiles import pad_to as _pad_to, round_up as _round_up
+
+__all__ = ["topk"]
+
+#: kernel entry -> its tier-1 equivalence test (see the ``kernel-test``
+#: selfcheck rule; the test forces ``interpret=True`` on the CPU mesh).
+KERNEL_EQUIVALENCE_TESTS = {
+    "topk": "test_kernel_plane.py::test_topk_matches_lax",
+}
+
+_NEG_INF = float("-inf")
+_BIG = 2 ** 30
+_ROWS = 8          # batch rows per grid step (one f32 sublane tile)
+_LANES = 128       # scratch lane width (k <= _LANES)
+
+
+def _extract_max(s, col):
+    """(max value [R, 1], its lowest column index [R, 1], s and col
+    with that one entry CONSUMED).  Consumption masks BOTH the value
+    (to -inf) and the column (to _BIG): value-only masking is a no-op
+    on an entry that is already -inf, so a mostly-masked row (padded
+    logits, ANN scores) would re-extract the same (-inf, col) pair
+    every pass and emit duplicate indices -- the column mask makes the
+    next pass pick the next-lowest unconsumed column instead, matching
+    lax.top_k's ascending-index order over ties exactly."""
+    m = jnp.max(s, axis=1, keepdims=True)
+    hit = s == m
+    idx = jnp.min(jnp.where(hit, col, _BIG), axis=1, keepdims=True)
+    at = hit & (col == idx)
+    return m, idx, jnp.where(at, _NEG_INF, s), jnp.where(at, _BIG, col)
+
+
+def _insert(vals, idx, cand_v, cand_i, k: int):
+    """Replace the weakest of the k live entries when the candidate
+    ranks higher under (value desc, index asc)."""
+    weak_v = jnp.min(vals[:, :k], axis=1, keepdims=True)
+    weak_hit = vals[:, :k] == weak_v
+    weak_i = jnp.max(jnp.where(weak_hit, idx[:, :k], -1), axis=1,
+                     keepdims=True)
+    better = (cand_v > weak_v) | ((cand_v == weak_v) & (cand_i < weak_i))
+    at = weak_hit & (idx[:, :k] == weak_i) & better
+    new_v = jnp.where(at, cand_v, vals[:, :k])
+    new_i = jnp.where(at, cand_i, idx[:, :k])
+    return (jnp.concatenate([new_v, vals[:, k:]], axis=1),
+            jnp.concatenate([new_i, idx[:, k:]], axis=1))
+
+
+def _topk_kernel(x_ref, ov_ref, oi_ref, vals_scr, idx_scr, *,
+                 k: int, block_v: int, v_len: int, out_dtype):
+    vi = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        vals_scr[...] = jnp.full_like(vals_scr, _NEG_INF)
+        # DISTINCT sentinel indices: every (value, index) pair in the
+        # running state must be unique or the weakest-slot selection in
+        # _insert matches several slots at once and the state
+        # degenerates to k copies of one entry.  Real candidates carry
+        # column indices < _BIG, so sentinels always lose ties.
+        idx_scr[...] = _BIG + jax.lax.broadcasted_iota(
+            jnp.int32, idx_scr.shape, 1)
+
+    col = vi * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (_ROWS, block_v), 1)
+    s = jnp.where(col < v_len, x_ref[...].astype(jnp.float32), _NEG_INF)
+
+    vals = vals_scr[...]
+    idx = idx_scr[...]
+    # k masked max-passes pull the block's own top-k in order; each
+    # candidate then displaces the running state's weakest entry (or
+    # nothing).  Everything is [8, <=128] VPU work on VMEM-resident
+    # tiles -- the HBM traffic is the single streaming read of x.
+    for _ in range(k):
+        cand_v, cand_i, s, col = _extract_max(s, col)
+        vals, idx = _insert(vals, idx, cand_v, cand_i, k)
+    vals_scr[...] = vals
+    idx_scr[...] = idx
+
+    @pl.when(vi == nv - 1)
+    def _finalize():
+        vals = vals_scr[...][:, :k]
+        idx = idx_scr[...][:, :k]
+        out_v, out_i = [], []
+        for _ in range(k):
+            m = jnp.max(vals, axis=1, keepdims=True)
+            hit = vals == m
+            pick = jnp.min(jnp.where(hit, idx, _BIG), axis=1,
+                           keepdims=True)
+            out_v.append(m)
+            out_i.append(pick)
+            # Consume BOTH value and index (the _extract_max rule):
+            # value-only masking leaves an already--inf entry's index
+            # live and the next pass re-picks it.
+            consumed = hit & (idx == pick)
+            vals = jnp.where(consumed, _NEG_INF, vals)
+            idx = jnp.where(consumed, _BIG, idx)
+        pad = jnp.zeros((_ROWS, _LANES - k), dtype=jnp.float32)
+        ov_ref[...] = jnp.concatenate(out_v + [pad], axis=1) \
+            .astype(out_dtype)
+        oi_ref[...] = jnp.concatenate(
+            out_i + [pad.astype(jnp.int32)], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "block_v",
+                                             "interpret"))
+def topk(x, k: int, *, block_v: int = 2048,
+         interpret: bool | None = None):
+    """Top-k over the last axis of ``x`` [B, V] -> (values [B, k],
+    indices [B, k] int32), descending, ties to the lowest index --
+    ``lax.top_k``'s ordering contract, without the full sort."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, v = x.shape
+    if not 0 < k <= min(v, _LANES):
+        raise ValueError(
+            f"topk: k={k} must be in [1, min(V={v}, {_LANES})]")
+    b_pad = _round_up(max(b, _ROWS), _ROWS)
+    block_v = min(block_v, _round_up(max(v, _LANES), _LANES))
+    x_p = _pad_to(_pad_to(x, 0, b_pad), 1, block_v)
+    v_pad = x_p.shape[1]
+
+    kernel = functools.partial(_topk_kernel, k=k, block_v=block_v,
+                               v_len=v, out_dtype=x.dtype)
+    values, indices = pl.pallas_call(
+        kernel,
+        grid=(b_pad // _ROWS, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((_ROWS, block_v), lambda bi, vi: (bi, vi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_ROWS, _LANES), lambda bi, vi: (bi, 0)),
+            pl.BlockSpec((_ROWS, _LANES), lambda bi, vi: (bi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b_pad, _LANES), x.dtype),
+            jax.ShapeDtypeStruct((b_pad, _LANES), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_ROWS, _LANES), jnp.float32),
+            pltpu.VMEM((_ROWS, _LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(x_p)
+    return values[:b, :k], indices[:b, :k]

@@ -1,0 +1,26 @@
+"""Shared tile/padding arithmetic for the Pallas kernel plane -- one
+authority for the sublane/lane rounding every kernel module needs
+(four drifting copies is exactly the class of duplication the
+kernel-plane selfcheck rules exist to prevent)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["pad_to", "round_up"]
+
+
+def round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+def pad_to(x, axis: int, multiple: int):
+    """Zero-pad ``x`` along ``axis`` up to the next multiple (no copy
+    when already aligned)."""
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)

@@ -312,16 +312,29 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
     from aiko_services_tpu.models.batching import (ContinuousBatcher,
                                                    Request)
 
-    max_seq = 1024
-    slots = 8
-    prompt_len = 384
-    max_new = 256
-    decode_iters = 256
-    config = dataclasses.replace(llama.LlamaConfig.llama3_1b(),
-                                 max_seq=max_seq)
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        max_seq, slots, prompt_len, max_new = 1024, 8, 384, 256
+        decode_iters = 256
+        config = dataclasses.replace(llama.LlamaConfig.llama3_1b(),
+                                     max_seq=max_seq)
+    else:
+        # cpu-smoke profile: the SAME serving code paths at a shape the
+        # CPU mesh finishes in seconds, recorded with llm_profile so a
+        # cpu round's figures are never mistaken for TPU numbers (the
+        # TPU-only subsections -- long-context, 8k decode, kernel
+        # %-of-peak -- are skipped, not faked).
+        max_seq, slots, prompt_len, max_new = 512, 4, 96, 32
+        decode_iters = 16
+        config = llama.LlamaConfig(
+            vocab_size=2048, dim=256, n_layers=4, n_heads=8,
+            n_kv_heads=4, hidden_dim=512, max_seq=max_seq,
+            rope_theta=10_000.0)
     params = llama.init_params(jax.random.PRNGKey(0), config)
     rng = np.random.default_rng(0)
-    result = {"llm_model": "llama3-1b-class",
+    result = {"llm_model": "llama3-1b-class" if on_tpu
+              else "cpu-smoke-4L-256d",
+              "llm_profile": "tpu" if on_tpu else "cpu-smoke",
               "llm_batch": slots, "llm_prompt_len": prompt_len,
               "llm_max_new": max_new}
 
@@ -380,12 +393,12 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
             step_bytes * decode_iters / elapsed / hbm_peak, 3)
 
     # -- chunked prefill rate: admit a full prompt chunk-by-chunk --------
-    chunk = 512
+    chunk = 512 if on_tpu else 128
     chunk_flops = chunk * llama_flops_per_token(config, chunk / 2)
     # 48 chunks ~= 420 ms of device work: the ~100 ms tunnel RTT's
     # run-to-run variance stays under ~5% of the measurement (16 chunks
     # left it at ~20%, enough to swing the MFU figure).
-    prefill_iters = 48
+    prefill_iters = 48 if on_tpu else 4
 
     @jax.jit
     def prefill_loop(params, cache, chunk_tokens):
@@ -443,7 +456,7 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
     # Dense materializes the [S, T] logits per layer; flash streams
     # KV blocks through VMEM -- this is where the kernel pays off.
     long_seq, long_chunk = 8192, 2048
-    for impl in ("flash", "dense"):
+    for impl in (("flash", "dense") if on_tpu else ()):
         try:
             lc = dataclasses.replace(config, max_seq=long_seq,
                                      attention=impl)
@@ -494,8 +507,8 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
     lc_lengths = jnp.full((lc_slots,), lc_ctx - lc_iters - 1,
                           dtype=jnp.int32)
     qp = quantize_params(params)
-    for kv_tag, kv_dtype in (("bf16kv", "bfloat16"),
-                             ("int8kv", "int8")):
+    for kv_tag, kv_dtype in ((("bf16kv", "bfloat16"),
+                              ("int8kv", "int8")) if on_tpu else ()):
         lc_config = dataclasses.replace(config, max_seq=lc_ctx,
                                         kv_dtype=kv_dtype)
 
@@ -603,7 +616,7 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
     # on identical code).  Steady-state serving rate = generated tokens
     # / (admission + decode) time; the honest host-driven loops are
     # recorded alongside under *_host_* keys.
-    serve_max_new = 128                  # same budget as the host loop
+    serve_max_new = 128 if on_tpu else 32   # same budget as the host loop
 
     def serve_device(serve_params):
         prompts = jnp.asarray(
@@ -626,8 +639,10 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
                 jnp.ones((slots,), dtype=bool),
                 jnp.zeros((slots,), dtype=jnp.float32), key,
                 # What the batcher resolves at this shape: 'auto' picks
-                # the flash-decode kernel at a 1024 resident cache.
-                num_steps=serve_max_new - 1, use_flash=True)
+                # the flash-decode kernel at a 1024 resident cache
+                # (dense below the threshold on the cpu-smoke profile).
+                num_steps=serve_max_new - 1,
+                use_flash=max_seq >= config.flash_decode_threshold)
             return emitted.sum() + first.sum()
 
         key = jax.random.PRNGKey(0)
@@ -657,7 +672,7 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
     for i in range(slots):
         batcher.submit(Request(
             f"r{i}", list(rng.integers(0, config.vocab_size, prompt_len)),
-            max_new_tokens=128, emit=emit))    # same budget as blocked
+            max_new_tokens=serve_max_new, emit=emit))  # same budget
     batcher.run_until_drained(max_steps=10_000)
     elapsed = time.perf_counter() - start
     result["llm_serving_host_loop_tokens_per_sec"] = round(
@@ -679,7 +694,8 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
         # and the fused decode block both compile outside the timer.
         for i in range(slots):
             batcher.submit(Request(f"warm{i}", list(rng.integers(
-                0, config.vocab_size, 8)), max_new_tokens=80))
+                0, config.vocab_size, 8)),
+                max_new_tokens=80 if on_tpu else 16))
         batcher.run_until_drained(max_steps=400)
 
         def one_run(tag):
@@ -690,7 +706,8 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
                     f"{label}{tag}{i}",
                     list(rng.integers(0, config.vocab_size,
                                       prompt_len)),
-                    max_new_tokens=128, emit=emit))  # 128-token budget
+                    max_new_tokens=serve_max_new,
+                    emit=emit))          # same budget as blocked
             batcher.run_until_drained(max_steps=10_000)
             return emitted["n"] / (time.perf_counter() - start)
 
@@ -728,7 +745,8 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
             **kw)
         for i in range(slots):           # compile outside the timer
             batcher.submit(Request(f"warm{label}{i}", list(rng.integers(
-                0, config.vocab_size, 8)), max_new_tokens=80))
+                0, config.vocab_size, 8)),
+                max_new_tokens=80 if on_tpu else 16))
         batcher.run_until_drained(max_steps=400)
 
         def one_run(tag):
@@ -739,7 +757,7 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
                     f"loop{label}{tag}{i}",
                     list(rng.integers(0, config.vocab_size,
                                       prompt_len)),
-                    max_new_tokens=128, emit=emit))  # same budget
+                    max_new_tokens=serve_max_new, emit=emit))
             with ledger.guard():
                 batcher.run_until_drained(max_steps=10_000)
             return emitted["n"] / (time.perf_counter() - start)
@@ -782,6 +800,228 @@ def bench_llm(peak: float | None, rtt: float) -> dict:
                                       if fallback else None)
         if prior:
             result[f"{key}_vs_baseline"] = round(result[key] / prior, 2)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# 3b. Kernel plane (ISSUE 11): the paged flash-decode, chunk-verify,
+#     int8 dequant-matmul and top-k kernels against their XLA/dense
+#     references.  On TPU this measures the real kernels at serving
+#     shapes; on CPU every Pallas call runs in INTERPRET mode (an
+#     emulated grid loop), so the figures are recorded honestly under
+#     kernel_bench_profile=cpu-interpret -- correctness smoke + key
+#     wiring, NOT a performance claim (interpret overhead dominates and
+#     the ratios typically favor the XLA reference there).
+
+def bench_kernels(peak: float | None, rtt: float) -> dict:
+    import dataclasses
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from aiko_services_tpu.models import llama
+    from aiko_services_tpu.models.paged import init_paged_cache
+    from aiko_services_tpu.models.quant import quantize_weight
+
+    on_tpu = jax.default_backend() == "tpu"
+    hbm_peak = chip_peak_hbm()
+    result = {"kernel_bench_profile": "tpu" if on_tpu else
+              "cpu-interpret"}
+    rng = np.random.default_rng(0)
+
+    if on_tpu:
+        config = dataclasses.replace(llama.LlamaConfig.llama3_1b(),
+                                     max_seq=8192)
+        slots, iters, pt = 8, 64, 128
+        verify_iters, spec = 16, 4
+        mm_shape, mm_iters = (8, 2048, 128_256), 50
+        tk_shape, tk_k, tk_iters = (8, 128_256), 8, 50
+    else:
+        config = llama.LlamaConfig(
+            vocab_size=512, dim=128, n_layers=2, n_heads=8,
+            n_kv_heads=2, hidden_dim=256, max_seq=2048,
+            rope_theta=10_000.0)
+        slots, iters, pt = 4, 8, 128
+        verify_iters, spec = 4, 4
+        mm_shape, mm_iters = (8, 128, 2048), 20
+        tk_shape, tk_k, tk_iters = (8, 8192), 8, 20
+    ctx = config.max_seq
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size, slots),
+                         dtype=jnp.int32)
+    lengths = jnp.full((slots,), ctx - iters - 1, dtype=jnp.int32)
+
+    def fully_mapped_paged():
+        cache = init_paged_cache(config, slots, ctx, pt)
+        pps = ctx // pt
+        table = np.arange(1, slots * pps + 1,
+                          dtype=np.int32).reshape(slots, pps)
+        cache["page_table"] = jnp.asarray(table)
+        return cache
+
+    def decode_rate(cache_fn, use_flash):
+        @jax.jit
+        def loop(params, tokens, cache, lengths):
+            def body(carry, _):
+                tokens, cache, lengths = carry
+                logits, cache = llama.decode_step.__wrapped__(
+                    params, config, tokens, cache, lengths,
+                    use_flash=use_flash)
+                tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (tokens, cache, lengths + 1), None
+            (tokens, cache, _), _ = lax.scan(
+                body, (tokens, cache, lengths), None, length=iters)
+            return tokens.sum()
+
+        cache = cache_fn()
+        int(loop(params, tokens, cache, lengths))       # compile + warm
+        cache = cache_fn()
+        elapsed = time_device_loop(
+            lambda: int(loop(params, tokens, cache, lengths)), rtt,
+            samples=3)
+        return slots * iters / elapsed, elapsed
+
+    # -- paged flash-decode: the kernel walking the page table vs the
+    # gather-attention reference vs the dense-flash path on a dense
+    # cache of the same extent (the ISSUE 11 gate: paged >= dense).
+    paged_rate, paged_elapsed = decode_rate(fully_mapped_paged, True)
+    gather_rate, _ = decode_rate(fully_mapped_paged, False)
+    dense_flash_rate, _ = decode_rate(
+        lambda: llama.init_cache(config, slots, ctx), True)
+    result["llm_decode8k_paged_tokens_per_sec"] = round(paged_rate, 1)
+    result["llm_decode8k_paged_gather_tokens_per_sec"] = \
+        round(gather_rate, 1)
+    result["llm_decode8k_dense_flash_tokens_per_sec"] = \
+        round(dense_flash_rate, 1)
+    result["llm_decode8k_paged_vs_dense_flash"] = round(
+        paged_rate / dense_flash_rate, 3)
+    result["llm_decode8k_paged_vs_gather"] = round(
+        paged_rate / gather_rate, 3)
+    if on_tpu and hbm_peak:
+        # Decode is bandwidth-bound: the honest %-of-peak for the
+        # paged kernel is achieved HBM bytes (weights sans embed + the
+        # LIVE cache pages, streamed once per step) against chip peak.
+        cache = fully_mapped_paged()
+        step_bytes = (tree_bytes(params) - tree_bytes(params["embed"])
+                      + tree_bytes(cache))
+        result["llm_kernel_pct_peak"] = round(
+            step_bytes * iters / paged_elapsed / hbm_peak * 100, 1)
+        del cache
+    else:
+        result["llm_kernel_pct_peak"] = None
+        result["llm_kernel_pct_peak_note"] = \
+            "needs TPU hardware (cpu-interpret round)"
+
+    # -- batched chunk-verify: the speculative target step's
+    # concat-attention, kernel vs dense, on a dense stacked cache.
+    trash = ctx - 1
+    starts = jnp.full((slots,), ctx - iters - spec - 2,
+                      dtype=jnp.int32)
+    chunk = jnp.asarray(rng.integers(0, config.vocab_size,
+                                     (slots, spec + 1)),
+                        dtype=jnp.int32)
+
+    def verify_time(use_flash):
+        @jax.jit
+        def loop(cache, chunk, starts):
+            def body(i, carry):
+                cache, acc = carry
+                logits, cache = llama._chunk_verify(
+                    params, config, chunk + i, cache, starts, trash,
+                    use_flash=use_flash)
+                return (cache, acc + logits.sum().astype(jnp.float32))
+            cache, acc = lax.fori_loop(0, verify_iters, body,
+                                       (cache, jnp.float32(0.0)))
+            return acc
+
+        cache = llama.init_cache(config, slots, ctx)
+        float(loop(cache, chunk, starts))               # compile + warm
+        cache = llama.init_cache(config, slots, ctx)
+        elapsed = time_device_loop(
+            lambda: float(loop(cache, chunk, starts)), rtt, samples=3)
+        return elapsed / verify_iters * 1000.0
+
+    result["chunk_verify_kernel_ms"] = round(verify_time(True), 3)
+    result["chunk_verify_dense_ms"] = round(verify_time(False), 3)
+    result["chunk_verify_vs_dense"] = round(
+        result["chunk_verify_dense_ms"]
+        / result["chunk_verify_kernel_ms"], 3)
+
+    # -- fused int8 dequant-matmul vs the XLA cast-into-dot + scale
+    # pair, at the unembed projection's shape.
+    from aiko_services_tpu.ops.pallas_matmul import int8_matmul
+
+    m, d, f = mm_shape
+    weight = quantize_weight(jnp.asarray(
+        rng.normal(size=(d, f)), jnp.float32))
+    x = jnp.asarray(rng.normal(size=(m, d)), jnp.bfloat16)
+
+    @jax.jit
+    def mm_kernel(x, w, s):
+        def body(i, acc):
+            out = int8_matmul(x + (i * 1e-6).astype(x.dtype), w, s)
+            return acc + out.astype(jnp.float32).sum()
+        return lax.fori_loop(0, mm_iters, body, jnp.float32(0.0))
+
+    @jax.jit
+    def mm_xla(x, w, s):
+        def body(i, acc):
+            xi = x + (i * 1e-6).astype(x.dtype)
+            out = (xi @ w.astype(xi.dtype)) * s.astype(xi.dtype)
+            return acc + out.astype(jnp.float32).sum()
+        return lax.fori_loop(0, mm_iters, body, jnp.float32(0.0))
+
+    for key, fn in (("int8_matmul_ms", mm_kernel),
+                    ("int8_matmul_xla_ms", mm_xla)):
+        float(fn(x, weight["int8"], weight["scale"]))    # compile
+        elapsed = time_device_loop(
+            lambda: float(fn(x, weight["int8"], weight["scale"])), rtt,
+            samples=3)
+        result[key] = round(elapsed / mm_iters * 1000.0, 4)
+    result["int8_matmul_vs_xla"] = round(
+        result["int8_matmul_xla_ms"] / result["int8_matmul_ms"], 3)
+
+    # -- on-TPU top-k vs lax.top_k at the sampling shape.
+    from aiko_services_tpu.ops.pallas_topk import topk as pallas_topk
+
+    logits = jnp.asarray(rng.normal(size=tk_shape), jnp.float32)
+
+    def tk_loop(impl):
+        @jax.jit
+        def loop(logits):
+            def body(i, acc):
+                values, _ = impl(logits + i * 1e-6, tk_k)
+                return acc + values.sum()
+            return lax.fori_loop(0, tk_iters, body, jnp.float32(0.0))
+        float(loop(logits))                              # compile
+        elapsed = time_device_loop(lambda: float(loop(logits)), rtt,
+                                   samples=3)
+        return elapsed / tk_iters * 1000.0
+
+    pallas_ms = tk_loop(lambda x, k: pallas_topk(x, k))
+    lax_ms = tk_loop(lambda x, k: jax.lax.top_k(x, k))
+    result["topk_pallas_ms"] = round(pallas_ms, 4)
+    result["topk_lax_ms"] = round(lax_ms, 4)
+    # kernel minus lax: NEGATIVE = the kernel is faster.
+    result["topk_vs_lax_ms"] = round(pallas_ms - lax_ms, 4)
+
+    previous = _previous_bench()
+    for key in ("llm_decode8k_paged_tokens_per_sec",
+                "llm_kernel_pct_peak", "chunk_verify_vs_dense",
+                "int8_matmul_vs_xla"):
+        prior = previous.get(key)
+        if prior and result.get(key):
+            result[f"{key}_vs_baseline"] = round(result[key] / prior, 2)
+    # topk_vs_lax_ms is a SIGNED difference (negative = kernel faster):
+    # a ratio against the prior round flips sign or inflates across
+    # zero, so its baseline delta is a subtraction (negative = this
+    # round is faster than the last).
+    prior = previous.get("topk_vs_lax_ms")
+    if prior is not None and result.get("topk_vs_lax_ms") is not None:
+        result["topk_vs_lax_ms_vs_baseline"] = round(
+            result["topk_vs_lax_ms"] - prior, 4)
     return result
 
 
@@ -2306,10 +2546,16 @@ def main() -> int:
     except Exception as error:
         record["rtt_error"] = f"{type(error).__name__}: {error}"
         rtt = 0.0
+    # AIKO_BENCH_SECTIONS=control,kernels,... runs a comma-named subset
+    # (names with or without the bench_ prefix); unset runs everything.
+    wanted = {part.strip().removeprefix("bench_")
+              for part in os.environ.get("AIKO_BENCH_SECTIONS",
+                                         "").split(",") if part.strip()}
     for name, section in (
             ("bench_control", bench_control),
             ("bench_detect", lambda: bench_detect(peak, rtt)),
             ("bench_llm", lambda: bench_llm(peak, rtt)),
+            ("bench_kernels", lambda: bench_kernels(peak, rtt)),
             ("bench_pipeline_e2e", bench_pipeline_e2e),
             ("bench_pipeline_fusion", bench_pipeline_fusion),
             ("bench_pipeline_transport", bench_pipeline_transport),
@@ -2319,6 +2565,8 @@ def main() -> int:
             ("bench_pipeline_replicas", bench_pipeline_replicas),
             ("bench_asr", lambda: bench_asr(rtt)),
             ("bench_speech_e2e", bench_speech_e2e)):
+        if wanted and name.removeprefix("bench_") not in wanted:
+            continue
         try:
             record.update(section())
         except Exception as error:          # keep the other sections

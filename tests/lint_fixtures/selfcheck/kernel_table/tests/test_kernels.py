@@ -1,0 +1,5 @@
+"""Fixture-twin equivalence test the kernels.py registry references."""
+
+
+def test_undocumented_kernel():
+    pass

@@ -1,0 +1,9 @@
+"""Healthy baseline: one registered hook with a matching run site."""
+
+
+class Engine:
+    def __init__(self):
+        self.add_hook("engine.frame:0")
+
+    def step(self):
+        self.run_hook("engine.frame:0", {})

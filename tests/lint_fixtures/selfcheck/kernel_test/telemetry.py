@@ -1,0 +1,7 @@
+"""Span-bearing pipeline hooks the telemetry plane consumes (fixture twin)."""
+
+SPAN_HOOKS = (
+    "pipeline.process_element:0", "pipeline.process_element_post:0",
+    "pipeline.process_segment:0", "pipeline.process_segment_post:0",
+    "pipeline.process_stage:0", "pipeline.process_stage_post:0",
+    "pipeline.stage_hop:0")

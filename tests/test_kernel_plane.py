@@ -1,0 +1,572 @@
+"""Kernel plane (ISSUE 11): paged flash-decode, batched chunk-verify,
+fused int8 dequant-matmul and on-TPU top-k -- every kernel exercised
+under ``interpret=True`` on the CPU mesh, so the equivalence tests gate
+PRs without TPU hardware (the ``kernel-test`` selfcheck rule enforces
+the kernel <-> test pairing repo-wide)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiko_services_tpu.models import llama
+from aiko_services_tpu.models.paged import init_paged_cache
+from aiko_services_tpu.models.quant import (dequantize_kv, quantize_kv,
+                                            quantize_params,
+                                            quantize_weight)
+from aiko_services_tpu.ops import decode_backend, matmul_backend, topk
+from aiko_services_tpu.ops.layers import (attention_decode_append,
+                                          attention_prefill)
+from aiko_services_tpu.ops.pallas_decode import (
+    _prep_query, _split_paged, flash_decode_append_paged,
+    flash_decode_attention, flash_decode_attention_paged,
+    flash_verify_append)
+from aiko_services_tpu.ops.pallas_matmul import int8_matmul
+from aiko_services_tpu.ops.pallas_topk import topk as pallas_topk
+
+
+# -- paged flash-decode -----------------------------------------------------
+
+def _paged_case(key, dtype=jnp.float32, quantized=False):
+    """A small paged pool + table whose gathered view is the dense
+    reference: L=2 layers, 3 slots x 4 logical pages of 32 tokens."""
+    L, P, pt, B, K, G, hd = 2, 13, 32, 3, 2, 2, 16
+    C = K * hd
+    table = jnp.asarray([[1, 2, 3, 0], [4, 5, 6, 7], [8, 9, 0, 0]],
+                        dtype=jnp.int32)
+    lengths = jnp.asarray([70, 128, 33], dtype=jnp.int32)
+    raw_k = jax.random.normal(key, (L, P, pt, K, hd), dtype=jnp.float32)
+    raw_v = jax.random.normal(jax.random.fold_in(key, 1),
+                              (L, P, pt, K, hd), dtype=jnp.float32)
+    if quantized:
+        qk, qv = quantize_kv(raw_k), quantize_kv(raw_v)
+        pool_k = {"int8": qk["int8"].reshape(L, P, pt, C),
+                  "scale": qk["scale"]}
+        pool_v = {"int8": qv["int8"].reshape(L, P, pt, C),
+                  "scale": qv["scale"]}
+        dense_k = dequantize_kv(qk, jnp.float32)
+        dense_v = dequantize_kv(qv, jnp.float32)
+    else:
+        pool_k = raw_k.reshape(L, P, pt, C).astype(dtype)
+        pool_v = raw_v.reshape(L, P, pt, C).astype(dtype)
+        dense_k = pool_k.reshape(L, P, pt, K, hd)
+        dense_v = pool_v.reshape(L, P, pt, K, hd)
+    q = jax.random.normal(jax.random.fold_in(key, 2), (B, 1, K * G, hd),
+                          dtype=dtype)
+    k_new = jax.random.normal(jax.random.fold_in(key, 3), (B, 1, K, hd),
+                              dtype=dtype)
+    v_new = jax.random.normal(jax.random.fold_in(key, 4), (B, 1, K, hd),
+                              dtype=dtype)
+    return (pool_k, pool_v, dense_k, dense_v, table, lengths, q, k_new,
+            v_new, dict(L=L, P=P, pt=pt, B=B, K=K, G=G, hd=hd, C=C))
+
+
+def test_paged_kernel_bitwise_matches_dense_kernel():
+    """f32 acceptance gate: the paged kernel walking the page table
+    in-kernel is BITWISE identical to the dense split-K kernel run on
+    the gathered contiguous view (same block size -> same op sequence),
+    on every layer -- the strongest possible no-gather equivalence."""
+    (pool_k, pool_v, dense_k, dense_v, table, lengths, q, _, _,
+     dims) = _paged_case(jax.random.PRNGKey(0))
+    B, pt, K, hd, C = (dims["B"], dims["pt"], dims["K"], dims["hd"],
+                       dims["C"])
+    h = q.shape[2]
+    q_pad, _, _, _ = _prep_query(q[:, 0], h, K, hd)
+    for layer in range(dims["L"]):
+        gathered = pool_k[layer][table].reshape(B, -1, C)
+        gathered_v = pool_v[layer][table].reshape(B, -1, C)
+        acc_d, m_d, l_d = flash_decode_attention(
+            q_pad, gathered, gathered_v, None, None, lengths,
+            block_t=pt, interpret=True)
+        acc_p, m_p, l_p = flash_decode_attention_paged(
+            q_pad, pool_k, pool_v, None, None, jnp.int32(layer), table,
+            lengths, interpret=True)
+        for dense, paged in ((acc_d, acc_p), (m_d, m_p), (l_d, l_p)):
+            assert np.array_equal(np.asarray(dense), np.asarray(paged))
+
+
+def test_paged_append_matches_dense_reference_f32():
+    (pool_k, pool_v, dense_k, dense_v, table, lengths, q, k_new, v_new,
+     dims) = _paged_case(jax.random.PRNGKey(1))
+    B, K, hd = dims["B"], dims["K"], dims["hd"]
+    layer = 1
+    out = flash_decode_append_paged(
+        q, _split_paged(pool_k), _split_paged(pool_v), jnp.int32(layer),
+        k_new, v_new, table, lengths, interpret=True)
+    gathered_k = dense_k[layer][table].reshape(B, -1, K, hd)
+    gathered_v = dense_v[layer][table].reshape(B, -1, K, hd)
+    reference = attention_decode_append(q, gathered_k, gathered_v,
+                                        k_new, v_new, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(reference),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_paged_append_int8_pools_dequantized_in_kernel():
+    """int8 scale pools ride their pages and dequantize in-kernel --
+    exact relative to dequantize-then-dense (no weight quantization on
+    this path, the flash-decode discipline)."""
+    (pool_k, pool_v, dense_k, dense_v, table, lengths, q, k_new, v_new,
+     dims) = _paged_case(jax.random.PRNGKey(2), quantized=True)
+    B, K, hd = dims["B"], dims["K"], dims["hd"]
+    layer = 0
+    out = flash_decode_append_paged(
+        q, _split_paged(pool_k), _split_paged(pool_v), jnp.int32(layer),
+        k_new, v_new, table, lengths, interpret=True)
+    reference = attention_decode_append(
+        q, dense_k[layer][table].reshape(B, -1, K, hd),
+        dense_v[layer][table].reshape(B, -1, K, hd), k_new, v_new,
+        lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(reference),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_paged_append_bf16_tolerance():
+    (pool_k, pool_v, dense_k, dense_v, table, lengths, q, k_new, v_new,
+     dims) = _paged_case(jax.random.PRNGKey(3), dtype=jnp.bfloat16)
+    B, K, hd = dims["B"], dims["K"], dims["hd"]
+    layer = 1
+    out = flash_decode_append_paged(
+        q, _split_paged(pool_k), _split_paged(pool_v), jnp.int32(layer),
+        k_new, v_new, table, lengths, interpret=True)
+    reference = attention_decode_append(
+        q, dense_k[layer][table].reshape(B, -1, K, hd).astype(q.dtype),
+        dense_v[layer][table].reshape(B, -1, K, hd).astype(q.dtype),
+        k_new, v_new, lengths)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(reference, dtype=np.float32), atol=6e-2, rtol=6e-2)
+
+
+def test_mixed_quantization_paged_views_rejected():
+    (pool_k, pool_v, *_rest, table_lengths) = _paged_case(
+        jax.random.PRNGKey(4))
+    (pool_kq, pool_vq, _, _, table, lengths, q, k_new, v_new,
+     _) = _paged_case(jax.random.PRNGKey(4), quantized=True)
+    with pytest.raises(ValueError, match="quantization state"):
+        flash_decode_append_paged(
+            q, _split_paged(pool_kq), _split_paged(pool_v),
+            jnp.int32(0), k_new, v_new, table, lengths, interpret=True)
+
+
+# -- decode_step / decode_loop integration ----------------------------------
+
+def _fully_mapped_paged_cache(config, batch, page_tokens):
+    """Paged cache with every slot's logical pages mapped to distinct
+    physical pages (full provisioning, deterministic layout)."""
+    cache = init_paged_cache(config, batch, config.max_seq, page_tokens)
+    pps = config.max_seq // page_tokens
+    table = np.arange(1, batch * pps + 1, dtype=np.int32) \
+        .reshape(batch, pps)
+    cache["page_table"] = jnp.asarray(table)
+    return cache
+
+
+def _paged_decode_logits(config, steps=6):
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    cache = _fully_mapped_paged_cache(config, 2, 32)
+    lengths = jnp.zeros(2, dtype=jnp.int32)
+    outs = []
+    for step in range(steps):
+        tokens = jnp.asarray([10 + step, 20 + step], dtype=jnp.int32)
+        logits, cache = llama.decode_step(params, config, tokens, cache,
+                                          lengths)
+        lengths = lengths + 1
+        outs.append(logits)
+    return jnp.stack(outs)
+
+
+def test_decode_step_paged_kernel_matches_dense_gather():
+    """decode_step on a paged cache with decode_attention='flash' (the
+    request that used to RAISE) evolves the same cache and produces the
+    same logits as the dense gather path over multiple steps."""
+    base = llama.LlamaConfig.tiny(vocab_size=64, max_seq=128)
+    dense = _paged_decode_logits(
+        dataclasses.replace(base, decode_attention="dense"))
+    flash = _paged_decode_logits(
+        dataclasses.replace(base, decode_attention="flash"))
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               atol=5e-2, rtol=2e-2)
+
+
+def test_decode_loop_paged_kernel_token_identical():
+    """The device-resident serving loop on a paged cache: paged-kernel
+    vs reference backends emit IDENTICAL token streams at temperature 0
+    (greedy ties broken the same way on this seed)."""
+    base = llama.LlamaConfig.tiny(vocab_size=64, max_seq=128)
+    streams = {}
+    for name, attention in (("kernel", "flash"), ("reference", "dense")):
+        config = dataclasses.replace(base, decode_attention=attention)
+        params = llama.init_params(jax.random.PRNGKey(0), config)
+        cache = _fully_mapped_paged_cache(config, 2, 32)
+        out = llama.decode_loop(
+            params, config,
+            jnp.asarray([7, 11], dtype=jnp.int32), cache,
+            jnp.asarray([1, 1], dtype=jnp.int32),
+            jnp.ones(2, dtype=bool),
+            jnp.full((2,), 12, dtype=jnp.int32),
+            jnp.zeros(2, dtype=jnp.float32),
+            jnp.full((2, 1), -1, dtype=jnp.int32),
+            jnp.full((2, 1), -1, dtype=jnp.int32),
+            jax.random.PRNGKey(5), ring=8)
+        emitted, counts = out[0], out[1]
+        streams[name] = (np.asarray(emitted), np.asarray(counts))
+    assert np.array_equal(streams["kernel"][1], streams["reference"][1])
+    assert np.array_equal(streams["kernel"][0], streams["reference"][0])
+
+
+def test_decode_backend_capability_probe():
+    """The probe replaces the old raise: paged + explicit flash is the
+    paged kernel; auto follows extent/threshold/structure; distributed
+    and dense force the reference path."""
+    assert decode_backend("flash", paged=True,
+                          page_tokens=64) == "paged-kernel"
+    assert decode_backend("auto", paged=True, extent=2048,
+                          threshold=1024,
+                          page_tokens=64) == "paged-kernel"
+    assert decode_backend("auto", paged=True, extent=256,
+                          threshold=1024, page_tokens=64) == "reference"
+    assert decode_backend("auto", paged=True, extent=2048,
+                          threshold=1024, page_tokens=6) == "reference"
+    assert decode_backend("flash") == "dense-flash"
+    assert decode_backend("auto", extent=2048,
+                          threshold=1024) == "dense-flash"
+    assert decode_backend("auto", extent=2000,
+                          threshold=1024) == "reference"   # % 128
+    assert decode_backend("flash", paged=True, distributed=True,
+                          page_tokens=64) == "reference"
+    assert decode_backend("dense", extent=8192) == "reference"
+
+
+# -- batched chunk-verify ---------------------------------------------------
+
+def _verify_reference(k_rows, v_rows, q, k_new, v_new, starts,
+                      positions):
+    """The dense concat-attention _chunk_verify computes, verbatim."""
+    b, t = k_rows.shape[:2]
+    s = q.shape[1]
+    k_all = jnp.concatenate([k_rows, k_new], axis=1)
+    v_all = jnp.concatenate([v_rows, v_new], axis=1)
+    kv_positions = jnp.concatenate(
+        [jnp.broadcast_to(jnp.arange(t)[None, :], (b, t)), positions],
+        axis=1)
+    valid = jnp.concatenate(
+        [jnp.arange(t)[None, :] < starts[:, None],
+         jnp.ones((b, s), dtype=bool)], axis=1)
+    return attention_prefill(q, k_all, v_all, positions,
+                             kv_length_mask=valid,
+                             kv_positions=kv_positions)
+
+
+def test_chunk_verify_kernel_matches_dense():
+    """flash_verify_append == the dense concat path at f32, across a
+    zero-start row, a mid-cache row and a trash-clamped boundary row --
+    stacked AND paged cache forms, raw and int8."""
+    key = jax.random.PRNGKey(6)
+    L, B, K, G, hd, S, T = 2, 3, 2, 2, 16, 5, 128
+    C, H = K * hd, K * G
+    starts = jnp.asarray([0, 17, T - 1], dtype=jnp.int32)
+    positions = jnp.minimum(starts[:, None] + jnp.arange(S)[None, :],
+                            T - 1)
+    q = jax.random.normal(jax.random.fold_in(key, 1), (B, S, H, hd),
+                          dtype=jnp.float32)
+    k_new = jax.random.normal(jax.random.fold_in(key, 2), (B, S, K, hd),
+                              dtype=jnp.float32)
+    v_new = jax.random.normal(jax.random.fold_in(key, 3), (B, S, K, hd),
+                              dtype=jnp.float32)
+
+    # stacked raw
+    k_cache = jax.random.normal(jax.random.fold_in(key, 4), (L, B, T, C),
+                                dtype=jnp.float32)
+    v_cache = jax.random.normal(jax.random.fold_in(key, 5), (L, B, T, C),
+                                dtype=jnp.float32)
+    layer = 1
+    out = flash_verify_append(q, (k_cache, None), (v_cache, None),
+                              jnp.int32(layer), k_new, v_new, starts,
+                              positions, interpret=True)
+    reference = _verify_reference(
+        k_cache[layer].reshape(B, T, K, hd),
+        v_cache[layer].reshape(B, T, K, hd), q, k_new, v_new, starts,
+        positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(reference),
+                               atol=1e-5, rtol=1e-5)
+
+    # stacked int8: in-kernel dequant vs dequantize-then-dense
+    raw_k = jax.random.normal(jax.random.fold_in(key, 6),
+                              (L, B, T, K, hd), dtype=jnp.float32)
+    raw_v = jax.random.normal(jax.random.fold_in(key, 7),
+                              (L, B, T, K, hd), dtype=jnp.float32)
+    qk, qv = quantize_kv(raw_k), quantize_kv(raw_v)
+    k_view = (qk["int8"].reshape(L, B, T, C),
+              qk["scale"][..., 0].transpose(0, 1, 3, 2)
+              .astype(jnp.float32))
+    v_view = (qv["int8"].reshape(L, B, T, C),
+              qv["scale"][..., 0].transpose(0, 1, 3, 2)
+              .astype(jnp.float32))
+    out = flash_verify_append(q, k_view, v_view, jnp.int32(layer),
+                              k_new, v_new, starts, positions,
+                              interpret=True)
+    reference = _verify_reference(
+        dequantize_kv(qk, jnp.float32)[layer],
+        dequantize_kv(qv, jnp.float32)[layer], q, k_new, v_new, starts,
+        positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(reference),
+                               atol=1e-4, rtol=1e-4)
+
+    # paged: table walked in-kernel
+    P, pt, pps = 13, 32, 4
+    pool_k = jax.random.normal(jax.random.fold_in(key, 8),
+                               (L, P, pt, C), dtype=jnp.float32)
+    pool_v = jax.random.normal(jax.random.fold_in(key, 9),
+                               (L, P, pt, C), dtype=jnp.float32)
+    table = jnp.asarray([[1, 2, 3, 0], [4, 5, 6, 7], [8, 9, 10, 11]],
+                        dtype=jnp.int32)
+    out = flash_verify_append(q, (pool_k, None), (pool_v, None),
+                              jnp.int32(layer), k_new, v_new, starts,
+                              positions, page_table=table,
+                              interpret=True)
+    reference = _verify_reference(
+        pool_k[layer][table].reshape(B, pps * pt, K, hd),
+        pool_v[layer][table].reshape(B, pps * pt, K, hd), q, k_new,
+        v_new, starts, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(reference),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_chunk_verify_wired_into_speculative_loop():
+    """_chunk_verify with use_flash routes through the kernel and
+    produces the same logits and cache as the dense concat path."""
+    from aiko_services_tpu.models.llama import _chunk_verify
+
+    config = dataclasses.replace(
+        llama.LlamaConfig.tiny(vocab_size=64, max_seq=128),
+        dtype="float32")
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    chunk = jnp.asarray([[5, 9, 2], [1, 3, 3]], dtype=jnp.int32)
+    starts = jnp.asarray([4, 19], dtype=jnp.int32)
+    trash = config.max_seq - 1
+
+    outs = {}
+    for use_flash in (False, True):
+        cache = llama.init_cache(config, 2)
+        logits, new_cache = jax.jit(
+            lambda c: _chunk_verify(params, config, chunk, c, starts,
+                                    trash, use_flash=use_flash))(cache)
+        outs[use_flash] = (logits, new_cache)
+    np.testing.assert_allclose(np.asarray(outs[True][0]),
+                               np.asarray(outs[False][0]),
+                               atol=1e-4, rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(outs[True][1]),
+                    jax.tree_util.tree_leaves(outs[False][1])):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32),
+            np.asarray(b, dtype=np.float32), atol=1e-5, rtol=1e-5)
+
+
+# -- fused int8 dequant-matmul ----------------------------------------------
+
+def test_int8_matmul_matches_xla():
+    """Exact on exactly-representable inputs; f32 accumulation-order
+    tolerance on gaussian bf16 -- vs the XLA reference
+    ``(x @ w.astype) * scale`` (llama.matmul's non-kernel path)."""
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.integers(-7, 8, (96, 260)), jnp.float32)
+    leaf = quantize_weight(w)
+    x = jnp.asarray(rng.integers(-3, 4, (5, 96)), jnp.float32)
+    reference = (x @ leaf["int8"].astype(x.dtype)) \
+        * leaf["scale"].astype(x.dtype)
+    out = int8_matmul(x, leaf["int8"], leaf["scale"], block_f=128,
+                      block_d=32, interpret=True)
+    assert np.array_equal(np.asarray(out), np.asarray(reference))
+
+    xb = jax.random.normal(jax.random.PRNGKey(0), (4, 96), jnp.bfloat16)
+    reference = (xb @ leaf["int8"].astype(xb.dtype)) \
+        * leaf["scale"].astype(xb.dtype)
+    out = int8_matmul(xb, leaf["int8"], leaf["scale"], interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(reference, dtype=np.float32), atol=1e-1, rtol=2e-2)
+
+
+def test_int8_matmul_serves_the_unembed():
+    """decode_step logits with matmul_kernel='pallas' (the fused
+    kernel on the quantized unembed, interpret mode here) match
+    matmul_kernel='off' (XLA) on the same int8 tree."""
+    base = llama.LlamaConfig.tiny(vocab_size=64, max_seq=64)
+    params = quantize_params(
+        llama.init_params(jax.random.PRNGKey(0), base))
+    tokens = jnp.asarray([3, 5], dtype=jnp.int32)
+    lengths = jnp.zeros(2, dtype=jnp.int32)
+    logits = {}
+    for mode in ("off", "pallas"):
+        config = dataclasses.replace(base, matmul_kernel=mode)
+        cache = llama.init_cache(config, 2)
+        out, _ = llama.decode_step(params, config, tokens, cache,
+                                   lengths)
+        logits[mode] = np.asarray(out, dtype=np.float32)
+    np.testing.assert_allclose(logits["pallas"], logits["off"],
+                               atol=5e-2, rtol=5e-2)
+    assert matmul_backend("off") == "reference"
+    assert matmul_backend("pallas") == "pallas-int8"
+
+
+# -- on-TPU top-k -----------------------------------------------------------
+
+def test_topk_matches_lax():
+    """Values AND indices equal lax.top_k across shapes, block sizes
+    and dtypes -- including the ragged tail and a bf16 operand."""
+    rng = np.random.default_rng(1)
+    for (b, v, k, block_v) in ((5, 700, 8, 256), (1, 64, 3, 2048),
+                               (17, 5000, 16, 1024), (8, 128, 128, 128)):
+        x = jnp.asarray(rng.normal(size=(b, v)), jnp.float32)
+        values, indices = pallas_topk(x, k, block_v=block_v,
+                                      interpret=True)
+        lax_values, lax_indices = jax.lax.top_k(x, k)
+        assert np.array_equal(np.asarray(values), np.asarray(lax_values))
+        assert np.array_equal(np.asarray(indices),
+                              np.asarray(lax_indices))
+    xb = jnp.asarray(rng.normal(size=(9, 333)), jnp.bfloat16)
+    values, indices = pallas_topk(xb, 5, block_v=128, interpret=True)
+    lax_values, lax_indices = jax.lax.top_k(xb, 5)
+    assert values.dtype == jnp.bfloat16
+    assert np.array_equal(np.asarray(values, dtype=np.float32),
+                          np.asarray(lax_values, dtype=np.float32))
+    assert np.array_equal(np.asarray(indices), np.asarray(lax_indices))
+
+
+def test_int8_matmul_blocks_over_m():
+    """Prefill-shaped M (B*S rows) exercises the M-blocking that keeps
+    the kernel's VMEM tiles bounded on TPU -- with block_m smaller than
+    M, partial tiles and the ragged M tail must still match XLA."""
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.integers(-7, 8, (64, 384)), jnp.float32)
+    leaf = quantize_weight(w)
+    x = jnp.asarray(rng.integers(-3, 4, (300, 64)), jnp.float32)
+    reference = (x @ leaf["int8"].astype(x.dtype)) \
+        * leaf["scale"].astype(x.dtype)
+    out = int8_matmul(x, leaf["int8"], leaf["scale"], block_m=128,
+                      block_f=128, block_d=32, interpret=True)
+    assert np.array_equal(np.asarray(out), np.asarray(reference))
+
+
+def test_topk_masked_rows_match_lax():
+    """Rows with fewer than k finite values (padded logits, masked ANN
+    scores): the consumed-column mask keeps extracted (-inf, index)
+    candidates DISTINCT, so indices stay unique and match lax.top_k's
+    ascending order over the -inf tail (value-only masking re-extracted
+    the same entry and emitted duplicates)."""
+    x = jnp.full((3, 256), -jnp.inf)
+    x = x.at[0, 3].set(1.0).at[0, 7].set(2.0)       # 2 finite < k=4
+    x = x.at[1, 200].set(5.0)                       # 1 finite, tail block
+    values, indices = pallas_topk(x, 4, block_v=128, interpret=True)
+    lax_values, lax_indices = jax.lax.top_k(x, 4)
+    assert np.array_equal(np.asarray(indices), np.asarray(lax_indices))
+    assert np.array_equal(np.asarray(values), np.asarray(lax_values))
+    for row in np.asarray(indices):
+        assert len(set(row.tolist())) == 4          # no duplicates
+
+
+def test_topk_tie_breaking_is_stable():
+    """Equal values resolve to the LOWEST index first -- lax.top_k's
+    stable contract, pinned explicitly (ties across block boundaries
+    are exactly what the running-state merge could get wrong)."""
+    x = jnp.zeros((3, 600)).at[:, 5].set(2.0).at[:, 300].set(2.0) \
+        .at[:, 10].set(1.0).at[:, 599].set(1.0)
+    values, indices = pallas_topk(x, 4, block_v=128, interpret=True)
+    lax_values, lax_indices = jax.lax.top_k(x, 4)
+    assert np.array_equal(np.asarray(indices), np.asarray(lax_indices))
+    assert np.array_equal(np.asarray(values), np.asarray(lax_values))
+    assert list(np.asarray(indices[0])) == [5, 300, 10, 599]
+
+
+def test_paged_kernel_rejects_misaligned_page_size():
+    """A forced paged-kernel request with a sublane-misaligned page
+    size fails by name on every backend instead of surfacing an opaque
+    Mosaic tiling error on TPU (the 'auto' probe never routes such a
+    config here)."""
+    L, P, pt, B, C = 1, 3, 12, 2, 32
+    pool = jnp.zeros((L, P, pt, C), dtype=jnp.float32)
+    table = jnp.zeros((B, 2), dtype=jnp.int32)
+    q_pad = jnp.zeros((B, 4, C), dtype=jnp.float32)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        flash_decode_attention_paged(q_pad, pool, pool, None, None,
+                                     jnp.int32(0), table,
+                                     jnp.zeros(B, dtype=jnp.int32),
+                                     interpret=True)
+
+
+def test_sample_top_k_bounded_at_build_and_create():
+    """sample_top_k above the kernel's 128-lane cap fails at batcher
+    build AND at create-time domain validation -- not mid-serving on
+    TPU (the CPU path would happily serve it via lax.top_k)."""
+    from aiko_services_tpu.analysis.params import \
+        validate_element_parameters
+    from aiko_services_tpu.models.batching import ContinuousBatcher
+
+    config = llama.LlamaConfig.tiny(vocab_size=64, max_seq=64)
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    with pytest.raises(ValueError, match="128"):
+        ContinuousBatcher(params, config, max_slots=2,
+                          sample_top_k=200)
+    findings = validate_element_parameters(
+        "LLM", {"sample_top_k": 200}, "p: llm",
+        module="aiko_services_tpu.elements.llm")
+    assert [f.rule for f in findings] == ["bad-parameter"]
+    assert "<= 128" in findings[0].message
+
+
+def test_topk_rejects_bad_k():
+    x = jnp.zeros((2, 64))
+    with pytest.raises(ValueError, match="k="):
+        pallas_topk(x, 0, interpret=True)
+    with pytest.raises(ValueError, match="k="):
+        pallas_topk(x, 129, interpret=True)
+
+
+def test_select_tokens_top_k_restricts_sampling():
+    """top_k=1 at temperature > 0 equals greedy (the candidate set is
+    the argmax); top_k=0 keeps the full categorical; greedy rows are
+    unaffected by top_k.  The dispatching ops.topk interface resolves
+    to lax off-TPU, so this exercises the serving wiring."""
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(jax.random.fold_in(key, 1), (4, 64))
+    temps = jnp.asarray([0.0, 0.7, 1.0, 0.3])
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    top1 = np.asarray(llama.select_tokens(key, logits, temps, top_k=1))
+    assert np.array_equal(top1, greedy)
+    # top_k restricts every sampled row's token to the k candidates
+    k = 4
+    _, candidates = topk(jnp.asarray(logits, jnp.float32), k,
+                         kernel=False)
+    for draw in range(5):
+        out = np.asarray(llama.select_tokens(
+            jax.random.fold_in(key, draw), logits, temps, top_k=k))
+        for row in range(4):
+            assert out[row] in np.asarray(candidates[row])
+
+
+def test_batcher_sample_top_k_round_trip():
+    """ContinuousBatcher(sample_top_k=1) at temperature>0 emits the
+    greedy stream (top-1 == argmax), through the real serving loop."""
+    from aiko_services_tpu.models.batching import (ContinuousBatcher,
+                                                   Request)
+
+    config = llama.LlamaConfig.tiny(vocab_size=64, max_seq=64)
+    params = llama.init_params(jax.random.PRNGKey(0), config)
+    streams = {}
+    for label, kwargs in (
+            ("greedy", dict()),
+            ("top1", dict(sample_top_k=1))):
+        batcher = ContinuousBatcher(params, config, max_slots=2,
+                                    decode_block_tokens=8, **kwargs)
+        collected = []
+        temperature = 0.0 if label == "greedy" else 0.9
+        batcher.submit(Request(
+            "r", [5, 9, 2, 7], max_new_tokens=10,
+            temperature=temperature,
+            emit=lambda rid, tok, fin: collected.append(tok)))
+        batcher.run_until_drained(max_steps=200)
+        streams[label] = collected
+    assert streams["top1"] == streams["greedy"]

@@ -53,6 +53,8 @@ SELFCHECK_FIXTURES = {
     "resume_identity": "resume-identity",
     "parameter_registry": "parameter-registry",
     "metric_registry": "metric-registry",
+    "kernel_test": "kernel-test",
+    "kernel_table": "kernel-table",
 }
 
 
