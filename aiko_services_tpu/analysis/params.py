@@ -151,6 +151,21 @@ PIPELINE_PARAMETERS: dict[str, ParamSpec] = {
         "multi-host mesh mode: {hosts: N, coordinator, process_id} "
         "(dict or JSON; AIKO_MESH_* env equivalent)",
         kind="json"),
+    # -- gateway front door + unified QoS (ISSUE 12) -------------------
+    "gateway": ParamSpec(
+        "HTTP + WebSocket front door service (gateway/server.py)",
+        choices=("on", "off", "true", "false", "0", "1")),
+    "gateway_host": ParamSpec(
+        "interface the gateway binds (default 127.0.0.1; use a "
+        "routable address to serve real clients)"),
+    "gateway_port": ParamSpec(
+        "gateway listen port (0 = kernel-assigned, echoed on "
+        "share.gateway_port)", number=True, minimum=0),
+    "qos": ParamSpec(
+        "unified QoS policy: {classes, tenants, default_tenant, "
+        "promote_ms, age_ms, max_inflight, session_window} (dict or "
+        "JSON) -- the ONE admission authority every plane consults",
+        kind="json"),
 }
 
 
@@ -279,6 +294,15 @@ def _check_value(name: str, spec: ParamSpec, value, spot: str) \
         problem = mesh_spec_error(value)
         if problem is not None:
             return Finding("bad-parameter", f"mesh: {problem}", spot)
+    if spec.kind == "json" and name == "qos" and value:
+        # The gateway's tenant/class/budget policy (ISSUE 12):
+        # validated by the same jax-free twin the runtime parse uses
+        # (gateway/qos.py qos_spec_error), so a malformed tenant block
+        # fails at create time, not under load.
+        from ..gateway.qos import qos_spec_error
+        problem = qos_spec_error(value)
+        if problem is not None:
+            return Finding("bad-parameter", f"qos: {problem}", spot)
     return None
 
 

@@ -371,6 +371,93 @@ def lint(paths, self_check, strict, list_rules):
                       echo=click.echo))
 
 
+# -- gateway load generator --------------------------------------------------
+
+@main.command("loadgen")
+@click.option("--host", default=None,
+              help="target a RUNNING gateway at this host (with "
+                   "--port); default builds a self-contained 2-stage "
+                   "pipeline + gateway on loopback")
+@click.option("--port", default=None, type=int,
+              help="target gateway port (with --host)")
+@click.option("--rate", default=25.0,
+              help="interactive tenant arrival rate, frames/sec "
+                   "(open loop)")
+@click.option("--overload", default=2.0,
+              help="batch tenants' combined rate as a multiple of "
+                   "--rate (2.0 = 2x overload pressure)")
+@click.option("--frames", default=100,
+              help="frames per tenant")
+@click.option("--deadline-ms", default=0.0,
+              help="per-frame deadline for the interactive tenant "
+                   "(0 = none)")
+@click.option("--busy-ms", default=5.0,
+              help="self-contained mode: per-stage busy time")
+def loadgen(host, port, rate, overload, frames, deadline_ms, busy_ms):
+    """Open-loop mixed-tenant load against a gateway: an interactive
+    tenant at --rate plus a batch tenant at --rate * --overload,
+    per-class p50/p99/goodput and per-tenant shed/reject counts as
+    JSON (the same generator bench_pipeline_gateway drives)."""
+    import json as json_module
+    import threading
+
+    from .gateway.loadgen import LoadSpec, run_loadgen
+
+    specs = [
+        LoadSpec("alice", "interactive", rate, int(frames),
+                 data={"x": [1.0] * 16},
+                 deadline_ms=deadline_ms or 0.0),
+        LoadSpec("bulk", "batch", rate * overload,
+                 int(frames * overload), data={"x": [1.0] * 16}),
+    ]
+    if host is not None and port is not None:
+        click.echo(json_module.dumps(run_loadgen(host, port, specs),
+                                     indent=2))
+        return
+    if (host is None) != (port is None):
+        raise click.UsageError("--host and --port go together")
+    from .pipeline import Pipeline
+
+    runtime = _runtime("loopback")
+
+    def stage(name):
+        return {"name": name, "input": [{"name": "x"}],
+                "output": [{"name": "x"}],
+                "parameters": {"busy_ms": busy_ms, "factor": 2.0},
+                "placement": {"devices": "auto"},
+                "deploy": {"local": {
+                    "module": "aiko_services_tpu.elements.common",
+                    "class_name": "StageWork"}}}
+
+    instance = Pipeline(
+        {"version": 0, "name": "loadgen", "runtime": "jax",
+         "graph": ["(detect llm)"],
+         "parameters": {
+             "gateway": "on",
+             "qos": {"classes": {"batch": {"device_inflight": 1}},
+                     "tenants": {
+                         "alice": {"class": "interactive",
+                                   "budget": 64},
+                         "bulk": {"class": "batch", "budget": 16}},
+                     "max_inflight": 64}},
+         "elements": [stage("detect"), stage("llm")]},
+        runtime=runtime)
+    report: list = []
+
+    def drive():
+        try:
+            report.append(run_loadgen("127.0.0.1",
+                                      instance.gateway.port, specs))
+        finally:
+            runtime.engine.terminate()
+
+    threading.Thread(target=drive, daemon=True,
+                     name="loadgen-driver").start()
+    runtime.run()
+    if report:
+        click.echo(json_module.dumps(report[0], indent=2))
+
+
 # -- critical-path explain (offline) ----------------------------------------
 
 @main.command("explain")

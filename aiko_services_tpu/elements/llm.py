@@ -413,11 +413,20 @@ class LLM(PipelineElement):
         self._start_worker()
         # Parameters resolve HERE (loop thread, current-stream context
         # intact); the worker consumes pre-resolved values.  The model
-        # settings ride along until the first request builds it.
+        # settings ride along until the first request builds it.  The
+        # stream's QoS identity rides too (ISSUE 12): the batcher's
+        # slot admission is the fourth plane of the unified scheduler.
         model_params = None if self._batcher is not None \
             else self._resolve_model_params()
+        qos = getattr(self.pipeline, "qos", None)
+        qos_info = (getattr(stream, "tenant", None),
+                    getattr(stream, "qos_class", None),
+                    0 if qos is None
+                    else qos.class_rank(getattr(stream, "qos_class",
+                                                None)))
         self._work.put(("request", str(stream.stream_id), text, complete,
-                        self._resolve_request_params(), model_params))
+                        self._resolve_request_params(), model_params,
+                        qos_info))
 
     def stop_stream(self, stream, stream_id):
         """Cancel the stream's outstanding requests: a frame parked here
@@ -444,12 +453,14 @@ class LLM(PipelineElement):
         (bad model parameter, broken checkpoint) errors ITS OWN frame
         and is swallowed -- one bad frame must not strand the others."""
         if item[0] == "request":
-            _, stream_id, text, complete, request_params, model_params \
-                = item
+            (_, stream_id, text, complete, request_params, model_params,
+             qos_info) = item
             try:
                 self._ensure_model(model_params)
                 request, collected = self._make_request(
                     stream_id, text, request_params)
+                request.tenant, request.qos_class, request.qos_rank = \
+                    qos_info
             except Exception as error:
                 self.logger.exception("LLM request setup failed")
                 complete(StreamEvent.ERROR,

@@ -1,0 +1,654 @@
+"""The gateway service: HTTP + WebSocket front door (ISSUE 12).
+
+One listening socket multiplexes thousands of client connections into
+pipeline streams:
+
+- **WebSocket** (``/v1/stream``) carries streaming sessions: a client
+  opens a session (tenant + priority class + optional per-frame
+  deadline), sends frames, and receives results **in ingest order** --
+  the session maps 1:1 onto a pipeline stream, so the engine's
+  reorder-buffer delivery contract IS the session's ordering
+  guarantee.  Sessions survive reconnects (``open`` with an existing
+  session id ATTACHES: the new connection takes over, results follow
+  it); a dangling disconnect destroys the session's stream so parked
+  frames and swag tensors never leak.
+- **HTTP** carries request/response (``POST /v1/frames``: one frame in,
+  one result out, a one-shot session under the hood) plus ``/healthz``
+  and ``/stats``.
+
+Admission happens HERE, at the door, against the pipeline's
+:class:`~aiko_services_tpu.gateway.qos.QosScheduler`: the tenant's
+token bucket rejects over-rate frames before they touch the engine
+(counted + ring-logged), the per-session window bounds in-flight
+frames per client (backpressure: the client sees ``busy`` instead of
+unbounded queueing), and everything admitted carries tenant/class into
+the engine where the SAME scheduler orders every internal seam.
+
+Transport notes: stdlib sockets only (tier-1 runs the whole path over
+loopback, no external broker); one daemon thread per connection plus
+one result pump per session -- the pump pays the ONE counted ledger
+fetch per result (the gateway is a wire sink under the device-resident
+swag contract, like ``_respond``'s process boundary).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import uuid
+
+from . import ws
+from .qos import QosScheduler
+from ..utils import get_logger
+
+__all__ = ["GatewayServer", "json_safe", "decode_data"]
+
+_logger = get_logger("aiko.gateway")
+
+_HTTP_TIMEOUT_S = 30.0          # one-shot HTTP frame round trip
+_ACCEPT_BACKLOG = 128
+
+
+def decode_data(data: dict) -> dict:
+    """Client frame payload -> engine swag: JSON lists of numbers
+    become numpy arrays (float32 when any member is fractional,
+    int32 otherwise -- the accelerator-native dtypes), scalars and
+    strings pass through.  A ``{"__tensor__": [...], "dtype": "..."}``
+    wrapper forces an explicit dtype."""
+    import numpy as np
+
+    def convert(value):
+        if isinstance(value, dict):
+            if "__tensor__" in value:
+                return np.asarray(value["__tensor__"],
+                                  dtype=np.dtype(
+                                      value.get("dtype", "float32")))
+            return {key: convert(entry)
+                    for key, entry in value.items()}
+        if isinstance(value, list):
+            flat = value
+            while isinstance(flat, list) and flat \
+                    and isinstance(flat[0], list):
+                # A mixed nested/scalar level ([[1,2], 3]) is ragged:
+                # fall through to the per-entry path, never crash.
+                if not all(isinstance(sub, list) for sub in flat):
+                    flat = []
+                    break
+                flat = [entry for sub in flat for entry in sub]
+            if flat and all(isinstance(entry, (int, float))
+                            and not isinstance(entry, bool)
+                            for entry in flat):
+                dtype = np.float32 if any(
+                    isinstance(entry, float) for entry in flat) \
+                    else np.int32
+                try:
+                    return np.asarray(value, dtype=dtype)
+                except ValueError:      # ragged: pass through as-is
+                    return value
+            return [convert(entry) for entry in value]
+        return value
+
+    return {str(key): convert(value) for key, value in
+            (data or {}).items()}
+
+
+def json_safe(value):
+    """Swag values -> JSON-encodable (arrays become nested lists,
+    scalars become numbers, anything opaque becomes its type name --
+    the recorder's redaction fallback, applied at the wire)."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, bytes):
+        return value.decode("latin-1")
+    if isinstance(value, dict):
+        return {str(key): json_safe(entry)
+                for key, entry in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [json_safe(entry) for entry in value]
+    tolist = getattr(value, "tolist", None)
+    if tolist is not None:
+        try:
+            return tolist()
+        except Exception:
+            pass
+    item = getattr(value, "item", None)
+    if item is not None:
+        try:
+            return item()
+        except Exception:
+            pass
+    return f"<{type(value).__name__}>"
+
+
+class _Session:
+    """One gateway session <-> one pipeline stream."""
+
+    def __init__(self, session_id: str, tenant: str, qos_class: str,
+                 deadline_ms: float, window: int):
+        import queue as queue_module
+        self.session_id = session_id
+        # Attach credential: minted on first open, returned in the
+        # ``opened`` ack, REQUIRED to attach -- a client that merely
+        # guesses a session id cannot hijack another tenant's stream.
+        self.token = uuid.uuid4().hex
+        self.stream_id = f"gw/{session_id}"
+        self.tenant = tenant
+        self.qos_class = qos_class
+        self.deadline_ms = deadline_ms
+        self.window = window
+        self.queue = queue_module.Queue()   # engine queue_response
+        self.conn: socket.socket | None = None
+        self.send_lock = threading.Lock()
+        self.state_lock = threading.Lock()
+        self.inflight = 0
+        self.sent = 0
+        self.results = 0
+        self.sent_times: list[float] = []   # FIFO; results are in-order
+        self.closing = False
+        self.pump: threading.Thread | None = None
+
+    def take_slot(self) -> "float | None":
+        """Reserve one window slot; returns the stamp to pass to
+        ``untake_slot`` if the frame is later refused (rate), or None
+        when the window is full."""
+        with self.state_lock:
+            if self.closing or self.inflight >= self.window:
+                return None
+            self.inflight += 1
+            self.sent += 1
+            stamp = time.monotonic()
+            self.sent_times.append(stamp)
+            return stamp
+
+    def untake_slot(self, stamp: float) -> None:
+        """Undo a reservation for a frame that never entered the
+        engine (token-bucket reject after the slot was taken): no
+        result will arrive, so its stamp must not pair with one."""
+        with self.state_lock:
+            self.inflight = max(0, self.inflight - 1)
+            self.sent = max(0, self.sent - 1)
+            try:
+                self.sent_times.remove(stamp)
+            except ValueError:
+                pass
+
+    def finish_slot(self) -> float:
+        """-> e2e seconds for the (in-order) completed frame."""
+        with self.state_lock:
+            self.inflight = max(0, self.inflight - 1)
+            self.results += 1
+            started = self.sent_times.pop(0) if self.sent_times else None
+        return 0.0 if started is None else time.monotonic() - started
+
+
+class GatewayServer:
+    """Serve one pipeline's front door on ``host:port`` (0 = kernel-
+    assigned, echoed on ``.port``)."""
+
+    def __init__(self, pipeline, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.pipeline = pipeline
+        # Lazy default policy: the server may bind BEFORE the pipeline
+        # finishes constructing (the endpoint is advertised as a
+        # registrar tag, so it binds pre-registration like the tensor
+        # pipe); the ``qos`` property below always reads the
+        # pipeline's live scheduler first.
+        self._default_qos: QosScheduler | None = None
+        self.sessions: dict[str, _Session] = {}
+        self._sessions_lock = threading.Lock()
+        self._http_seq = 0
+        self._stopped = False
+        self._sock = socket.create_server((host, int(port)),
+                                          backlog=_ACCEPT_BACKLOG)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"gateway-accept-{self.port}")
+        self._accept_thread.start()
+        _logger.info("gateway front door on %s:%d (/v1/stream ws, "
+                     "/v1/frames http)", host, self.port)
+
+    @property
+    def qos(self) -> QosScheduler:
+        """The pipeline's scheduler when it has one; otherwise a
+        default-policy instance so the door still resolves classes and
+        session windows (no rate limits, no budgets)."""
+        scheduler = getattr(self.pipeline, "qos", None)
+        if scheduler is not None:
+            return scheduler
+        if self._default_qos is None:
+            self._default_qos = QosScheduler()
+        return self._default_qos
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return                      # closed
+            threading.Thread(target=self._serve_connection,
+                             args=(conn,), daemon=True,
+                             name="gateway-conn").start()
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            # shutdown BEFORE close: close() alone does not wake a
+            # thread blocked in accept(), and the kernel socket kept
+            # accepting connections for process lifetime (found by
+            # the create-failure leak test).
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._sessions_lock:
+            sessions, self.sessions = dict(self.sessions), {}
+        for session in sessions.values():
+            session.closing = True
+            self._close_conn(session)
+            session.queue.put(None)     # retire the pump thread
+
+    @staticmethod
+    def _close_conn(session: _Session) -> None:
+        conn, session.conn = session.conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def session_count(self) -> int:
+        with self._sessions_lock:
+            return len(self.sessions)
+
+    # -- connection handling -----------------------------------------------
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(_HTTP_TIMEOUT_S)
+            head, body_start = self._read_head(conn)
+            if head is None:
+                return
+            request_line, headers = head
+            method, _, rest = request_line.partition(" ")
+            path = rest.split(" ", 1)[0]
+            upgrade = ws.server_handshake(headers)
+            if upgrade is not None:
+                conn.sendall(upgrade)
+                conn.settimeout(None)
+                self._serve_ws(conn)
+                return
+            self._serve_http(conn, method.upper(), path, headers,
+                             body_start)
+        except (OSError, ws.WsClosed, ConnectionError):
+            pass
+        except Exception:
+            _logger.exception("gateway connection failed")
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_head(conn: socket.socket):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = conn.recv(8192)
+            if not chunk:
+                return None, b""
+            data += chunk
+            if len(data) > 1 << 20:
+                raise ConnectionError("oversized request head")
+        head, _, remainder = data.partition(b"\r\n\r\n")
+        lines = head.decode("latin-1").split("\r\n")
+        headers = {}
+        for line in lines[1:]:
+            name, _, value = line.partition(":")
+            headers[name.strip().lower()] = value.strip()
+        return (lines[0], headers), remainder
+
+    # -- HTTP --------------------------------------------------------------
+
+    def _serve_http(self, conn, method: str, path: str, headers: dict,
+                    body_start: bytes) -> None:
+        if method == "GET" and path == "/healthz":
+            self._http_reply(conn, 200, {
+                "ok": True, "sessions": self.session_count(),
+                "streams": len(self.pipeline.streams)})
+            return
+        if method == "GET" and path == "/stats":
+            self._http_reply(conn, 200, {
+                "sessions": self.session_count(),
+                "qos": self.pipeline.qos_stats()})
+            return
+        if method == "POST" and path == "/v1/frames":
+            length = int(headers.get("content-length", "0"))
+            body = body_start
+            while len(body) < length:
+                chunk = conn.recv(length - len(body))
+                if not chunk:
+                    raise ConnectionError("truncated request body")
+                body += chunk
+            try:
+                request = json.loads(body.decode() or "{}")
+            except json.JSONDecodeError as error:
+                self._http_reply(conn, 400, {"error": f"bad JSON: "
+                                                      f"{error}"})
+                return
+            self._serve_http_frame(conn, request)
+            return
+        self._http_reply(conn, 404,
+                         {"error": "try /healthz, /stats, "
+                                   "/v1/frames or ws /v1/stream"})
+
+    def _serve_http_frame(self, conn, request: dict) -> None:
+        """One-shot request/response: a private session/stream per
+        request rides the same admission + delivery path as streaming
+        sessions, then tears down."""
+        tenant = str(request.get("tenant", "default"))
+        qos_class = self.qos.resolve_class(request.get("class"), tenant)
+        try:
+            # Decode BEFORE admission or stream creation: a malformed
+            # payload must cost a 400, not a burned rate token or a
+            # leaked stream.
+            data = decode_data(request.get("data"))
+        except Exception as error:
+            self._http_reply(conn, 400, {"error": "bad data",
+                                         "detail": str(error)[:200]})
+            return
+        admitted, reason = self._admit(tenant, qos_class, None)
+        if not admitted:
+            self._http_reply(conn, 429, {"error": "rejected",
+                                         "reason": reason})
+            return
+        with self._sessions_lock:
+            self._http_seq += 1
+            stream_id = f"gwhttp/{self._http_seq}"
+        import queue as queue_module
+        responses = queue_module.Queue()
+        parameters = {"tenant": tenant, "qos_class": qos_class}
+        deadline_ms = request.get("deadline_ms")
+        if deadline_ms is not None:
+            parameters["frame_deadline_ms"] = float(deadline_ms)
+        pipeline = self.pipeline
+        # Mailbox FIFO: the create lands before the ingest, so the
+        # frame sees the session's tenant/class/deadline parameters.
+        pipeline.post_self("create_stream_local",
+                           [stream_id, parameters, None, 0, responses])
+        pipeline.process_frame_local(data, stream_id=stream_id,
+                                     queue_response=responses)
+        try:
+            (_, frame_id, swag, metrics, okay, diagnostic) = \
+                responses.get(timeout=_HTTP_TIMEOUT_S)
+        except Exception:
+            self._http_reply(conn, 504, {"error": "timed out"})
+            return
+        finally:
+            pipeline.post_self("destroy_stream", [stream_id, True])
+        bare = {key: value for key, value in swag.items()
+                if "." not in key}
+        bare = pipeline.transfer_ledger.fetch(bare)
+        status = 200 if okay else 503
+        self._http_reply(conn, status, {
+            "ok": bool(okay), "frame": frame_id,
+            "data": json_safe(bare), "diagnostic": diagnostic,
+            "e2e_ms": round(float(metrics.get("time_pipeline", 0.0))
+                            * 1000.0, 3)})
+
+    @staticmethod
+    def _http_reply(conn, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  429: "Too Many Requests", 503: "Unavailable",
+                  504: "Gateway Timeout"}.get(status, "OK")
+        conn.sendall((f"HTTP/1.1 {status} {reason}\r\n"
+                      "Content-Type: application/json\r\n"
+                      f"Content-Length: {len(body)}\r\n"
+                      "Connection: close\r\n\r\n").encode() + body)
+
+    # -- admission ---------------------------------------------------------
+
+    def _admit(self, tenant: str, qos_class: str,
+               session: "_Session | None") -> tuple[bool, str]:
+        """Front-door admission for one frame: token bucket first,
+        then the session window (backpressure).  Counted + ring-logged
+        both ways."""
+        pipeline = self.pipeline
+        # Slot FIRST (atomically, under the session lock), bucket
+        # second: a backpressured frame must not burn one of the
+        # tenant's rate tokens on the way to its ``busy``, and two
+        # connections racing one session must not over-admit.
+        stamp = None
+        if session is not None:
+            stamp = session.take_slot()
+            if stamp is None:
+                admitted, reason = False, "window"
+            else:
+                admitted, reason = self.qos.admit(tenant, qos_class)
+                if not admitted:
+                    session.untake_slot(stamp)
+        else:
+            admitted, reason = self.qos.admit(tenant, qos_class)
+        telemetry = getattr(pipeline, "telemetry", None)
+        recorder = getattr(pipeline, "recorder", None)
+        # Metric labels carry the RESOLVED tenant entry's name, never
+        # the raw client string: past LAZY_TENANT_CAP unknown names
+        # share the default entry, so an attacker cycling tenant names
+        # cannot grow the metrics registry without bound (the registry
+        # never evicts label sets).
+        label = self.qos.tenant(tenant).name
+        if admitted:
+            if telemetry is not None:
+                telemetry.registry.count("gateway_admits",
+                                         tenant=label, cls=qos_class)
+            if recorder is not None:
+                recorder.record(
+                    "gw_admit",
+                    None if session is None else session.stream_id,
+                    None, label, None, {"cls": qos_class})
+        else:
+            if telemetry is not None:
+                telemetry.registry.count("gateway_rejects",
+                                         tenant=label, reason=reason)
+            if recorder is not None:
+                recorder.record(
+                    "gw_reject",
+                    None if session is None else session.stream_id,
+                    None, label, None,
+                    {"cls": qos_class, "reason": reason})
+        return admitted, reason
+
+    # -- WebSocket sessions ------------------------------------------------
+
+    def _serve_ws(self, conn: socket.socket) -> None:
+        session: _Session | None = None
+        try:
+            while True:
+                opcode, payload = ws.recv_message(conn)
+                try:
+                    message = json.loads(payload.decode())
+                except json.JSONDecodeError as error:
+                    self._ws_send_raw(conn, {"op": "error",
+                                             "error": f"bad JSON: "
+                                                      f"{error}"})
+                    continue
+                op = str(message.get("op", ""))
+                if op == "open":
+                    opened = self._ws_open(conn, message)
+                    if opened is not None:
+                        session = opened
+                elif op == "frame":
+                    self._ws_frame(conn, session, message)
+                elif op == "close":
+                    self._ws_close(conn, session)
+                    session = None
+                else:
+                    self._ws_send_raw(conn, {"op": "error",
+                                             "error": f"unknown op "
+                                                      f"{op!r}"})
+        except (ws.WsClosed, OSError, ConnectionError):
+            pass
+        finally:
+            # Dangling disconnect: clean up the pipeline stream --
+            # UNLESS another connection already attached (takeover),
+            # in which case this socket no longer owns the session.
+            if session is not None and session.conn is conn:
+                self._destroy_session(session)
+
+    def _ws_open(self, conn, message: dict) -> "_Session | None":
+        session_id = str(message.get("session") or uuid.uuid4().hex[:12])
+        tenant = str(message.get("tenant", "default"))
+        qos_class = self.qos.resolve_class(message.get("class"), tenant)
+        deadline_ms = float(message.get("deadline_ms") or 0.0)
+        # The client may request a SMALLER window (tighter client-side
+        # pipelining); the policy's session_window is the ceiling --
+        # a huge requested window must not defeat backpressure.
+        ceiling = max(1, int(self.qos.session_window))
+        window = max(1, min(int(message.get("window") or ceiling),
+                            ceiling))
+        with self._sessions_lock:
+            session = self.sessions.get(session_id)
+            attached = session is not None
+            if session is None:
+                session = _Session(session_id, tenant, qos_class,
+                                   deadline_ms, window)
+                self.sessions[session_id] = session
+        if attached:
+            if str(message.get("token") or "") != session.token:
+                # Attach is a takeover of a live stream: it requires
+                # the credential minted at open, not just the id.
+                self._ws_send_raw(conn, {"op": "error",
+                                         "error": "bad session token"})
+                return None
+            # Takeover: results follow the new connection.
+            with session.send_lock:
+                session.conn = conn
+        else:
+            session.conn = conn
+            parameters = {"tenant": tenant, "qos_class": qos_class}
+            if deadline_ms:
+                parameters["frame_deadline_ms"] = deadline_ms
+            self.pipeline.post_self(
+                "create_stream_local",
+                [session.stream_id, parameters, None, 0,
+                 session.queue])
+            session.pump = threading.Thread(
+                target=self._pump_results, args=(session,),
+                daemon=True, name=f"gateway-pump-{session_id}")
+            session.pump.start()
+        self._ws_send(session, {"op": "opened",
+                                "session": session_id,
+                                "token": session.token,
+                                "attached": attached,
+                                "class": session.qos_class,
+                                "window": session.window})
+        return session
+
+    def _ws_frame(self, conn, session: _Session | None,
+                  message: dict) -> None:
+        if session is None or session.closing \
+                or session.conn is not conn:
+            # No session, a closed one, or a connection another attach
+            # superseded: its frames must not auto-recreate the stream
+            # under default tenancy (ingest_local would) or bill the
+            # session's window.
+            self._ws_send_raw(conn, {"op": "rejected",
+                                     "reason": "no-session"})
+            return
+        try:
+            # BEFORE admission: a malformed payload must cost a
+            # ``rejected`` reply, never a taken window slot or (worse)
+            # the whole connection.
+            data = decode_data(message.get("data"))
+        except Exception as error:
+            self._ws_send(session, {"op": "rejected",
+                                    "reason": "bad-data",
+                                    "error": str(error)[:200]})
+            return
+        admitted, reason = self._admit(session.tenant,
+                                       session.qos_class, session)
+        if not admitted:
+            payload = {"op": "busy" if reason == "window"
+                       else "rejected",
+                       "reason": reason, "inflight": session.inflight}
+            tag = message.get("tag")
+            if tag is not None:
+                payload["tag"] = tag
+            self._ws_send(session, payload)
+            return
+        self.pipeline.process_frame_local(
+            data, stream_id=session.stream_id,
+            queue_response=session.queue)
+
+    def _ws_close(self, conn, session: _Session | None) -> None:
+        # Only the session's CURRENT connection may destroy it: a
+        # superseded connection's buffered close must not tear down a
+        # session another client just took over.
+        if session is not None and session.conn is conn:
+            self._destroy_session(session)
+        self._ws_send_raw(conn, {"op": "closed"})
+
+    def _destroy_session(self, session: _Session) -> None:
+        with self._sessions_lock:
+            self.sessions.pop(session.session_id, None)
+        session.closing = True
+        self.pipeline.post_self("destroy_stream",
+                                [session.stream_id, True])
+        session.queue.put(None)             # wake + retire the pump
+
+    def _pump_results(self, session: _Session) -> None:
+        """Per-session result pump: engine responses (already in
+        ingest order -- the stream's reorder buffer) go out on
+        whatever connection currently owns the session.  Pays the one
+        counted ledger fetch per result: the wire-sink contract."""
+        pipeline = self.pipeline
+        while True:
+            entry = session.queue.get()
+            if entry is None:
+                return
+            (_, frame_id, swag, metrics, okay, diagnostic) = entry
+            e2e_s = session.finish_slot()
+            bare = {key: value for key, value in swag.items()
+                    if "." not in key}
+            try:
+                bare = pipeline.transfer_ledger.fetch(bare)
+            except Exception as error:
+                okay, diagnostic = False, f"result fetch: {error}"
+                bare = {}
+            telemetry = getattr(pipeline, "telemetry", None)
+            if telemetry is not None:
+                telemetry.registry.observe("gateway_e2e_ms",
+                                           e2e_s * 1000.0,
+                                           cls=session.qos_class)
+            self._ws_send(session, {
+                "op": "result", "frame": frame_id, "ok": bool(okay),
+                "data": json_safe(bare), "diagnostic": diagnostic,
+                "e2e_ms": round(e2e_s * 1000.0, 3)})
+
+    def _ws_send(self, session: _Session, payload: dict) -> None:
+        with session.send_lock:
+            conn = session.conn
+            if conn is None:
+                return
+            try:
+                ws.send_frame(conn, json.dumps(payload))
+            except OSError:
+                # The pump outlives a dropped connection; results are
+                # simply not deliverable until a client re-attaches.
+                session.conn = None
+
+    @staticmethod
+    def _ws_send_raw(conn, payload: dict) -> None:
+        try:
+            ws.send_frame(conn, json.dumps(payload))
+        except OSError:
+            pass

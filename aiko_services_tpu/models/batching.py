@@ -117,6 +117,15 @@ class Request:
     #                                  the youngest victim)
     submit_time: float = 0.0         # llm_ttft_ms / llm_tpot_ms stamps
     first_time: float = 0.0
+    # Unified QoS admission (ISSUE 12, gateway/qos.py): the owning
+    # frame's tenant/class, and the pre-computed class rank slot
+    # admission sorts by (lower = more urgent; equal ranks keep
+    # submission order, so the default 0 everywhere is exactly the
+    # old FIFO).  Plane 4 of the one-scheduler refactor: the batcher
+    # admits by the same class vocabulary as the stage credits.
+    tenant: str | None = None
+    qos_class: str | None = None
+    qos_rank: int = 0
 
 
 _select_tokens = jax.jit(llama.select_tokens,
@@ -329,13 +338,24 @@ class ContinuousBatcher:
         request.submit_time = time.perf_counter()
         self.pending.append(request)
 
+    def _next_pending(self) -> Request:
+        """Pop the next request to admit: the best ``qos_rank`` (ISSUE
+        12 -- the batcher is the fourth admission plane the unified
+        scheduler reaches), queue position breaking ties so the
+        all-default case is EXACTLY the old FIFO and an evicted
+        request's front re-insert still wins its class."""
+        best = min(range(len(self.pending)),
+                   key=lambda index: (self.pending[index].qos_rank,
+                                      index))
+        return self.pending.pop(best)
+
     def _admit(self):
         """Assign free slots to pending requests (no device work: the
         prompt is written chunk-at-a-time by ``_prefill_tick``)."""
         for slot, occupant in enumerate(self.slots):
             if occupant is not None or not self.pending:
                 continue
-            request = self.pending.pop(0)
+            request = self._next_pending()
             request.slot = slot
             request.prefill_pos = 0
             request.admit_seq = self._admit_seq
@@ -1097,6 +1117,14 @@ class MicroBatcher:
     The worker dispatches EVERY group of a flush before fetching any
     (device work pipelines across groups).  Submit/flush/stop run on
     the event loop; only the queue crosses threads.
+
+    Scope note (found by the r07 bench attempt): a micro-batched
+    element on a REPLICATED placed stage is not yet supported -- the
+    replica hop lands each parked frame's inputs on ITS replica's
+    submesh, and a cross-replica group would stack arrays from
+    different device sets into one dispatch (XLA rejects the mix).
+    Replicate synchronous stages; async elements already spread load
+    through their own cross-stream batching.
     """
 
     def __init__(self, run: Callable, finish: Callable,
@@ -1108,7 +1136,7 @@ class MicroBatcher:
         self._schedule_flush = schedule_flush
         self._logger = logger
         self.name = name
-        self._pending: list[tuple] = []     # (key, payload, complete)
+        self._pending: list[tuple] = []  # (rank, seq, key, payload, complete)
         self._flush_scheduled = False
         self._queue: queue.Queue | None = None
         # perf counters (tests assert dispatches < frames)
@@ -1116,12 +1144,18 @@ class MicroBatcher:
         self.dispatches = 0
         self.flushes = 0
 
-    def submit(self, key, payload, complete, max_batch: int = 8):
+    def submit(self, key, payload, complete, max_batch: int = 8,
+               rank: int = 0):
         """Park one frame's work.  Flushes immediately at ``max_batch``
         pending, otherwise once the engine's mailboxes drain -- every
-        frame of the burst joins the same batched dispatch."""
+        frame of the burst joins the same batched dispatch.  ``rank``
+        is the frame's QoS class rank (ISSUE 12): a flush dispatches
+        best-ranked groups first, so an interactive frame's batch hits
+        the device before a batch-class group parked in the same
+        burst; all-equal ranks keep submission order exactly."""
         self._ensure_worker()
-        self._pending.append((key, payload, complete))
+        self._pending.append((int(rank), self.submitted, key, payload,
+                              complete))
         self.submitted += 1
         if len(self._pending) >= int(max_batch):
             self.flush()
@@ -1142,16 +1176,20 @@ class MicroBatcher:
 
     def flush(self):
         """Group pending frames by key (submission order preserved
-        within a group) and hand the burst to the worker."""
+        within a group) and hand the burst to the worker.  Groups
+        dispatch in best-(rank, submission) order -- the QoS plane;
+        with all-default ranks that IS first-submission order, the
+        pre-QoS behavior."""
         pending, self._pending = self._pending, []
         if not pending:
             return
         if self._queue is None:             # stopped mid-burst
-            for _, _, complete in pending:
+            for _, _, _, _, complete in pending:
                 complete_error(complete, f"{self.name} stopped")
             return
+        pending.sort(key=lambda entry: entry[:2])
         groups: dict = {}
-        for key, payload, complete in pending:
+        for _, _, key, payload, complete in pending:
             groups.setdefault(key, []).append((complete, payload))
         self.flushes += 1
         self.dispatches += len(groups)
@@ -1254,8 +1292,19 @@ class MicroBatchElement:
             complete_error(complete,     # complete errors
                            f"{diagnostic}: {error}")
             return
+        # Unified QoS admission (ISSUE 12): the parked frame's class
+        # rank orders the flush, so the batcher honors the same
+        # priority vocabulary as the stage credits.  Resolved on the
+        # event loop where the current-stream context is intact.
+        rank = 0
+        qos = getattr(self.pipeline, "qos", None)
+        if qos is not None:
+            stream = self.pipeline.current_stream()
+            if stream is not None:
+                rank = qos.class_rank(getattr(stream, "qos_class",
+                                              None))
         self._batcher.submit(key, payload, complete,
-                             max_batch=int(max_batch))
+                             max_batch=int(max_batch), rank=rank)
 
     def stop_microbatcher(self):
         """Flush + retire (a later submit lazily starts a fresh one)."""

@@ -318,6 +318,13 @@ class PipelineTelemetry:
                 # on the dashboard -- the live "where is time going".
                 result.setdefault("buckets", {})[name[6:-3]] = brief
                 continue
+            if name == "gateway_e2e_ms":
+                # Gateway front door (ISSUE 12): per-class session
+                # latency -- telemetry.gateway.* on the dashboard,
+                # the live per-class SLO view.
+                result.setdefault("gateway", {})[
+                    labels.get("cls", "?")] = brief
+                continue
             if name == "frame_latency_ms":
                 result["frame"] = brief
             elif name == "element_latency_ms":
@@ -350,6 +357,12 @@ class PipelineTelemetry:
                         "active": entry.get("active", []),
                         "occupancy": entry.get("occupancy", [])}
                 for stage, entry in replicas.get("stages", {}).items()}
+        # Unified QoS (ISSUE 12): per-tenant budget/in-flight/shed rows
+        # -- telemetry.tenants.* on the dashboard, next to the
+        # telemetry.gateway.* per-class latency above.
+        qos = getattr(self.pipeline, "qos", None)
+        if qos is not None:
+            result["tenants"] = qos.stats()["tenants"]
         return result
 
     def publish(self, force: bool = False) -> None:
@@ -431,6 +444,24 @@ class PipelineTelemetry:
                                stats.get("fallbacks", 0))
                 registry.gauge("tensor_pipe_dropped_frames",
                                stats.get("dropped_frames", 0))
+        # Gateway + unified QoS (ISSUE 12): live sessions, per-tenant
+        # in-flight vs budget, and token-bucket headroom -- the
+        # scrape-side view of who is over budget (and therefore who
+        # sheds first under overload).  The admit/reject/shed TOTALS
+        # are counters fed at the admission sites; only the
+        # instantaneous state refreshes here (the counter-vs-gauge
+        # discipline from PR 10).
+        gateway = getattr(pipeline, "gateway", None)
+        if gateway is not None:
+            registry.gauge("gateway_sessions", gateway.session_count())
+        qos = getattr(pipeline, "qos", None)
+        if qos is not None:
+            for tenant, entry in qos.stats()["tenants"].items():
+                registry.gauge("qos_inflight", entry["inflight"],
+                               tenant=tenant)
+                registry.gauge("qos_over_budget",
+                               1.0 if entry["over_budget"] else 0.0,
+                               tenant=tenant)
         # Flight recorder (ISSUE 10): ring depth + lifetime event count
         # -- a scrape-side signal the always-on recorder is recording
         # (and how far back a black-box dump's tail can reach).

@@ -37,6 +37,17 @@ credit-based backpressure) so frames overlap ACROSS submeshes, while
 this module's window keeps any one stream's dispatch bounded ahead of
 compute.  The two compose: ingest pacing bounds total outstanding
 device work, stage credits bound where in the pipeline it sits.
+
+Unified QoS (ISSUE 12): the window depth ``pace()`` is called with is
+no longer always the stream's raw ``device_inflight`` -- when the
+pipeline carries a :class:`~aiko_services_tpu.gateway.qos.QosScheduler`
+the limit is the stream's CLASS-capped depth
+(``Pipeline._device_limit`` -> ``QosScheduler.device_limit``), so a
+``batch``-class stream can be held to double buffering while
+``interactive`` keeps the full window on the same pipeline.  The
+window itself stays policy-free: it paces to whatever limit the one
+scheduler resolves, which is exactly what makes this seam plane 1 of
+the unified admission refactor.
 """
 
 from __future__ import annotations

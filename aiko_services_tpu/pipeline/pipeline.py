@@ -55,6 +55,7 @@ from ..observability import (BLACKBOX_LIMIT_DEFAULT,
 from ..analysis.lint import preflight as preflight_check
 from ..faults import (CircuitBreaker, FaultInjected, FaultPlan,
                       wire_fault_filter)
+from ..gateway.qos import QosScheduler
 from ..runtime import Lease
 from ..services import (Actor, ServiceFilter, ServiceTags,
                         get_service_proxy, do_discovery)
@@ -209,6 +210,27 @@ class Pipeline(Actor):
         tags = list(tags or [])
         if self._data_endpoint is not None:
             tags.append(f"{PIPE_TAG}={self._data_endpoint.location}")
+        # Gateway front door (ISSUE 12, gateway/server.py): ``gateway:
+        # on`` binds the HTTP + WebSocket service that funnels client
+        # connections into pipeline streams with per-tenant admission.
+        # Bound BEFORE the actor registers -- like the tensor pipe --
+        # so the registrar record advertises ``gateway=host:port``
+        # and the front door is a discoverable capability of the
+        # Service, per the source architecture.  Port 0 = kernel-
+        # assigned, echoed on ``share["gateway_port"]``.
+        self.gateway = None
+        gateway_mode = str(definition.parameters.get(
+            "gateway", "off")).strip().lower()
+        if gateway_mode in ("on", "true", "1"):
+            from ..gateway.server import GatewayServer
+            self.gateway = GatewayServer(
+                self,
+                host=str(definition.parameters.get(
+                    "gateway_host", "127.0.0.1")),
+                port=int(parse_number(
+                    definition.parameters.get("gateway_port"), 0)))
+            tags.append(f"gateway={self.gateway.host}:"
+                        f"{self.gateway.port}")
         self._pipe_senders: dict[str, PipeSender] = {}
         self._pipe_token_seq = 0
         self._pipe_fallback_logged: set = set()
@@ -220,144 +242,182 @@ class Pipeline(Actor):
         self._plane_counts = {"pipe_frames": 0, "pipe_bytes": 0,
                               "mqtt_frames": 0, "mqtt_bytes": 0,
                               "fallbacks": 0, "claims_dropped": 0}
-        super().__init__(name or definition.name, PROTOCOL_PIPELINE,
-                         tags=tags, runtime=runtime)
-        if preflight_report is not None:
-            for finding in preflight_report.findings:
-                self.logger.warning("pre-flight: %s", finding.render())
-        self.streams: dict[str, Stream] = {}
-        self._current_stream_ref: Stream | None = None
-        self._pipeline_parameters = dict(definition.parameters)
-        # Device-resident swag accounting (pipeline/overlap.py): the
-        # ``transfer_guard`` parameter sets the policy for every
-        # device-resident element's event-loop execution.
-        self.transfer_ledger = TransferLedger(
-            definition.parameters.get("transfer_guard", "allow"))
-        # Fused device-segment compilation (pipeline/fusion.py): every
-        # FusedSegment built for this pipeline's streams registers here
-        # (jit_stats / bench counters); the persistent XLA compile
-        # cache is wired once per process, env-gated.
-        self.fused_segments: list[FusedSegment] = []
-        setup_compilation_cache(definition.parameters)
-        # Replicated stages (ISSUE 7): stage -> (min, max) autoscale
-        # bounds resolved from the placement blocks' ``replicas`` specs
-        # (int N -> (N, N); "auto" -> (1, pool); {min, max} as given).
-        self._replica_bounds: dict[str, tuple[int, int]] = {}
-        self.stage_placement = self._build_placement()
-        self.stage_scheduler = self._build_stage_scheduler()
-        self._replica_failovers = 0
-        self._replica_rebuilds = 0
-        self.share["replica_failovers"] = 0
-        self.share["replica_rebuilds"] = 0
-        self.graph = self._build_graph()
-        self.share["element_count"] = len(self.graph)
-        self.share["streams"] = 0
-        self.share["frames_processed"] = 0
-        self._frames_processed = 0
-        self._remote_retries = 0
-        self.share["remote_stage_retries"] = 0
-        self.share["data_plane_frames"] = 0
-        self.share["data_plane_fallbacks"] = 0
-        self.share["tensor_pipe_dropped_frames"] = 0
-        # Failure recovery (ISSUE 5): fault-injection plan (None =
-        # unarmed, zero hot-path work), per-remote-stage circuit
-        # breakers, lazily built fallback elements, and the recovery
-        # counters the chaos suite asserts on.
-        self._faults: FaultPlan | None = None
-        self._wire_faults_installed = False
-        self.breakers: dict[str, CircuitBreaker] = {}
-        self._fallback_elements: dict[str, PipelineElement] = {}
-        self._frames_replayed = 0
-        self._frames_shed = 0
-        self._deadline_misses = 0
-        self.share["frames_replayed"] = 0
-        self.share["frames_shed"] = 0
-        self.share["deadline_misses"] = 0
-        self.share["faults_armed"] = False
+        # Everything past the gateway bind can raise a create-time
+        # DefinitionError (qos parse, placement carve, graph build,
+        # element load): the bound socket and its accept thread must
+        # not outlive a failed construction, serving a
+        # half-constructed pipeline forever.
+        try:
+            super().__init__(name or definition.name, PROTOCOL_PIPELINE,
+                             tags=tags, runtime=runtime)
+            if preflight_report is not None:
+                for finding in preflight_report.findings:
+                    self.logger.warning("pre-flight: %s", finding.render())
+            self.streams: dict[str, Stream] = {}
+            self._current_stream_ref: Stream | None = None
+            self._pipeline_parameters = dict(definition.parameters)
+            # Device-resident swag accounting (pipeline/overlap.py): the
+            # ``transfer_guard`` parameter sets the policy for every
+            # device-resident element's event-loop execution.
+            self.transfer_ledger = TransferLedger(
+                definition.parameters.get("transfer_guard", "allow"))
+            # Fused device-segment compilation (pipeline/fusion.py): every
+            # FusedSegment built for this pipeline's streams registers here
+            # (jit_stats / bench counters); the persistent XLA compile
+            # cache is wired once per process, env-gated.
+            self.fused_segments: list[FusedSegment] = []
+            setup_compilation_cache(definition.parameters)
+            # Unified QoS admission (ISSUE 12, gateway/qos.py): the ONE
+            # authority the four former admission planes consult --
+            # DeviceWindow pacing, StageScheduler credits, ReplicaGroup
+            # slot pick, batcher admission.  Absent ``qos`` parameter =
+            # None = every seam behaves exactly as before (FIFO,
+            # round-robin, per-stream overload only).
+            try:
+                self.qos: QosScheduler | None = QosScheduler.parse(
+                    definition.parameters.get("qos"))
+            except (ValueError, TypeError) as error:
+                # Pre-flight validates the block too (bad-parameter), but
+                # ``preflight: off`` must not smuggle a malformed QoS
+                # policy past create.
+                raise DefinitionError(
+                    f"pipeline {definition.name!r}: {error}")
+            self._qos_promotions = 0
+            self._qos_sheds = 0
+            self.share["qos_promotions"] = 0
+            self.share["qos_sheds"] = 0
+            # Replicated stages (ISSUE 7): stage -> (min, max) autoscale
+            # bounds resolved from the placement blocks' ``replicas`` specs
+            # (int N -> (N, N); "auto" -> (1, pool); {min, max} as given).
+            self._replica_bounds: dict[str, tuple[int, int]] = {}
+            self.stage_placement = self._build_placement()
+            self.stage_scheduler = self._build_stage_scheduler()
+            self._replica_failovers = 0
+            self._replica_rebuilds = 0
+            self.share["replica_failovers"] = 0
+            self.share["replica_rebuilds"] = 0
+            self.graph = self._build_graph()
+            self.share["element_count"] = len(self.graph)
+            self.share["streams"] = 0
+            self.share["frames_processed"] = 0
+            self._frames_processed = 0
+            self._remote_retries = 0
+            self.share["remote_stage_retries"] = 0
+            self.share["data_plane_frames"] = 0
+            self.share["data_plane_fallbacks"] = 0
+            self.share["tensor_pipe_dropped_frames"] = 0
+            # Failure recovery (ISSUE 5): fault-injection plan (None =
+            # unarmed, zero hot-path work), per-remote-stage circuit
+            # breakers, lazily built fallback elements, and the recovery
+            # counters the chaos suite asserts on.
+            self._faults: FaultPlan | None = None
+            self._wire_faults_installed = False
+            self.breakers: dict[str, CircuitBreaker] = {}
+            self._fallback_elements: dict[str, PipelineElement] = {}
+            self._frames_replayed = 0
+            self._frames_shed = 0
+            self._deadline_misses = 0
+            self.share["frames_replayed"] = 0
+            self.share["frames_shed"] = 0
+            self.share["deadline_misses"] = 0
+            self.share["faults_armed"] = False
 
-        self.add_hook("pipeline.process_frame:0")
-        self.add_hook("pipeline.process_element:0")
-        self.add_hook("pipeline.process_element_post:0")
-        self.add_hook("pipeline.process_segment:0")
-        self.add_hook("pipeline.process_segment_post:0")
-        self.add_hook("pipeline.process_stage:0")
-        self.add_hook("pipeline.process_stage_post:0")
-        self.add_hook("pipeline.stage_hop:0")
-        self.add_hook("pipeline.replacement:0")
-        self.add_hook("pipeline.replica_failover:0")
+            self.add_hook("pipeline.process_frame:0")
+            self.add_hook("pipeline.process_element:0")
+            self.add_hook("pipeline.process_element_post:0")
+            self.add_hook("pipeline.process_segment:0")
+            self.add_hook("pipeline.process_segment_post:0")
+            self.add_hook("pipeline.process_stage:0")
+            self.add_hook("pipeline.process_stage_post:0")
+            self.add_hook("pipeline.stage_hop:0")
+            self.add_hook("pipeline.replacement:0")
+            self.add_hook("pipeline.replica_failover:0")
 
-        # Telemetry plane (observability/): latency histograms, frame
-        # traces and the export surface, fed by the hooks above.
-        # ``telemetry: off`` disables it wholesale (hot-path cost drops
-        # back to a no-handler hook probe per event).
-        telemetry_mode = str(definition.parameters.get(
-            "telemetry", "on")).strip().lower()
-        if telemetry_mode in ("off", "false", "0"):
-            self.telemetry = None
-        else:
-            self.telemetry = PipelineTelemetry(
-                self,
-                window_s=float(parse_number(
-                    definition.parameters.get("telemetry_window"),
-                    HISTOGRAM_WINDOW_DEFAULT)),
-                trace_capacity=int(parse_number(
-                    definition.parameters.get("trace_capacity"),
-                    TRACE_CAPACITY_DEFAULT)),
-                publish_interval=float(parse_number(
-                    definition.parameters.get("telemetry_interval"),
-                    TELEMETRY_INTERVAL_DEFAULT)))
+            # Telemetry plane (observability/): latency histograms, frame
+            # traces and the export surface, fed by the hooks above.
+            # ``telemetry: off`` disables it wholesale (hot-path cost drops
+            # back to a no-handler hook probe per event).
+            telemetry_mode = str(definition.parameters.get(
+                "telemetry", "on")).strip().lower()
+            if telemetry_mode in ("off", "false", "0"):
+                self.telemetry = None
+            else:
+                self.telemetry = PipelineTelemetry(
+                    self,
+                    window_s=float(parse_number(
+                        definition.parameters.get("telemetry_window"),
+                        HISTOGRAM_WINDOW_DEFAULT)),
+                    trace_capacity=int(parse_number(
+                        definition.parameters.get("trace_capacity"),
+                        TRACE_CAPACITY_DEFAULT)),
+                    publish_interval=float(parse_number(
+                        definition.parameters.get("telemetry_interval"),
+                        TELEMETRY_INTERVAL_DEFAULT)))
 
-        # Flight recorder + black-box (ISSUE 10): an always-on bounded
-        # ring of typed engine events behind every seam below
-        # (``recorder: off`` -> None, and every emission site is an
-        # ``is not None`` no-op -- the unarmed-FaultPlan discipline).
-        # ``blackbox_dir`` arms crash-dump snapshots: deadline miss,
-        # replay, breaker open, replica failover and stream errors
-        # write the ring tail + in-flight frame states (redacted --
-        # ids/names/numbers only) to bounded JSON files that
-        # ``python -m aiko_services_tpu explain <dump>`` renders.
-        recorder_mode = str(definition.parameters.get(
-            "recorder", "on")).strip().lower()
-        if recorder_mode in ("off", "false", "0"):
-            self.recorder = None
-        else:
-            self.recorder = FlightRecorder(int(parse_number(
-                definition.parameters.get("recorder_capacity"),
-                RECORDER_CAPACITY_DEFAULT)))
-        self._blackbox_dir = definition.parameters.get(
-            "blackbox_dir") or None
-        if self._blackbox_dir is not None and self.recorder is None:
-            # Dumps ARE ring snapshots: without the recorder the
-            # configuration is dead -- say so at create, not at the
-            # crash the operator configured dumps to explain.
-            _logger.warning("blackbox_dir is set but recorder=off: "
-                            "no black-box dumps will be written")
-        self._blackbox_limit = int(parse_number(
-            definition.parameters.get("blackbox_limit"),
-            BLACKBOX_LIMIT_DEFAULT))
-        self.share["blackbox_dumps"] = 0
-        self._blackbox_dumps = 0
-        self._blackbox_last: dict[str, float] = {}
+            # Flight recorder + black-box (ISSUE 10): an always-on bounded
+            # ring of typed engine events behind every seam below
+            # (``recorder: off`` -> None, and every emission site is an
+            # ``is not None`` no-op -- the unarmed-FaultPlan discipline).
+            # ``blackbox_dir`` arms crash-dump snapshots: deadline miss,
+            # replay, breaker open, replica failover and stream errors
+            # write the ring tail + in-flight frame states (redacted --
+            # ids/names/numbers only) to bounded JSON files that
+            # ``python -m aiko_services_tpu explain <dump>`` renders.
+            recorder_mode = str(definition.parameters.get(
+                "recorder", "on")).strip().lower()
+            if recorder_mode in ("off", "false", "0"):
+                self.recorder = None
+            else:
+                self.recorder = FlightRecorder(int(parse_number(
+                    definition.parameters.get("recorder_capacity"),
+                    RECORDER_CAPACITY_DEFAULT)))
+            self._blackbox_dir = definition.parameters.get(
+                "blackbox_dir") or None
+            if self._blackbox_dir is not None and self.recorder is None:
+                # Dumps ARE ring snapshots: without the recorder the
+                # configuration is dead -- say so at create, not at the
+                # crash the operator configured dumps to explain.
+                _logger.warning("blackbox_dir is set but recorder=off: "
+                                "no black-box dumps will be written")
+            self._blackbox_limit = int(parse_number(
+                definition.parameters.get("blackbox_limit"),
+                BLACKBOX_LIMIT_DEFAULT))
+            self.share["blackbox_dumps"] = 0
+            self._blackbox_dumps = 0
+            self._blackbox_last: dict[str, float] = {}
 
-        self._health_timer = None
-        interval = self.definition.parameters.get("health_check_interval")
-        if interval and self.stage_placement is not None:
-            self._health_timer = self.runtime.engine.add_timer_handler(
-                self.check_device_health, float(interval))
-        # Replica autoscale control loop (ISSUE 7): re-splits replica
-        # counts from queue depth + per-replica occupancy, bounded by
-        # the declared {min, max}; 0/absent = no periodic loop (the
-        # ``autoscale_replicas`` method stays callable).
-        self._autoscale_timer = None
-        autoscale = parse_number(self.definition.parameters.get(
-            "replica_autoscale_interval"), 0.0)
-        if autoscale and self._has_elastic_replicas():
-            self._autoscale_timer = self.runtime.engine.add_timer_handler(
-                self.autoscale_replicas, float(autoscale))
+            if self.gateway is not None:
+                self.share["gateway_port"] = self.gateway.port
 
-        fault_plan = definition.parameters.get("fault_plan")
-        if fault_plan:
-            self.arm_faults(fault_plan)
+            self._health_timer = None
+            interval = self.definition.parameters.get("health_check_interval")
+            if interval and self.stage_placement is not None:
+                self._health_timer = self.runtime.engine.add_timer_handler(
+                    self.check_device_health, float(interval))
+            # Replica autoscale control loop (ISSUE 7): re-splits replica
+            # counts from queue depth + per-replica occupancy, bounded by
+            # the declared {min, max}; 0/absent = no periodic loop (the
+            # ``autoscale_replicas`` method stays callable).
+            self._autoscale_timer = None
+            autoscale = parse_number(self.definition.parameters.get(
+                "replica_autoscale_interval"), 0.0)
+            if autoscale and self._has_elastic_replicas():
+                self._autoscale_timer = self.runtime.engine.add_timer_handler(
+                    self.autoscale_replicas, float(autoscale))
+
+            fault_plan = definition.parameters.get("fault_plan")
+            if fault_plan:
+                self.arm_faults(fault_plan)
+        except BaseException:
+            if self.gateway is not None:
+                self.gateway.stop()
+                self.gateway = None
+            if self._data_endpoint is not None:
+                # Same class of leak, pre-existing: the tensor-pipe
+                # endpoint binds before registration too.
+                self._data_endpoint.close()
+                self._data_endpoint = None
+            raise
 
     # -- graph construction ------------------------------------------------
 
@@ -490,7 +550,8 @@ class Pipeline(Actor):
         replicas = {stage: len(plans) for stage, plans
                     in placement.replica_plans.items()}
         return StageScheduler(list(placement.plans), depth,
-                              replicas=replicas or None)
+                              replicas=replicas or None, qos=self.qos,
+                              on_promote=self._note_promotion)
 
     def _cancel_health_timer(self):
         if self._health_timer is not None:
@@ -1770,6 +1831,123 @@ class Pipeline(Actor):
                          f"shed: overload ({stream.overload_policy}, "
                          f"{stream.in_flight} in flight)")
 
+    # -- unified QoS admission (ISSUE 12, gateway/qos.py) ------------------
+
+    def _stamp_qos(self, stream: Stream, frame: Frame) -> None:
+        """Resolve the frame's tenant/class from its stream and open
+        the scheduler's in-flight accounting (closed exactly once by
+        ``_qos_done`` on any completion path).  The ingest sequence is
+        the rank tiebreak that keeps same-class (and per-stream)
+        arrival order."""
+        frame.tenant = stream.tenant
+        frame.qos_class = stream.qos_class
+        frame.qos_wait_start = time.monotonic()
+        if self.qos is None:
+            return
+        frame.qos_seq = self.qos.next_seq()
+        frame.qos_open = True
+        self.qos.frame_started(frame.tenant)
+
+    def _qos_done(self, frame: Frame) -> None:
+        """Close the scheduler's in-flight accounting for a frame
+        (idempotent -- the flag flips once)."""
+        if frame.qos_open:
+            frame.qos_open = False
+            if self.qos is not None:
+                self.qos.frame_finished(frame.tenant)
+
+    def _device_limit(self, stream: Stream) -> int:
+        """The stream's effective dispatch-window depth: per-class caps
+        from the QoS policy tighten the resolved ``device_inflight``
+        (plane 1 of the unified scheduler)."""
+        if self.qos is None:
+            return stream.device_inflight
+        return self.qos.device_limit(stream.qos_class,
+                                     stream.device_inflight)
+
+    def _qos_shed_for_overload(self, stream: Stream,
+                               frame: Frame) -> bool:
+        """Pipeline-wide QoS shedding at ingest (``max_inflight`` in
+        the qos block): when the engine is over budget, shed the WORST
+        victim across ALL streams -- over-budget tenants first, then
+        the lowest class, then the oldest -- which may be the incoming
+        frame itself (returns True: refuse it) or a queued frame of
+        another stream (failed in ITS reorder slot; the incoming frame
+        proceeds).  Only admission-queued frames are cancellable
+        victims, exactly like ``shed_oldest``."""
+        if self.qos is None or not self.qos.overloaded():
+            return False
+        # Severity is the (over_budget, class_rank) prefix; the seq
+        # component of shed_key only picks WHICH victim among the
+        # worst group (oldest first).  Only a victim STRICTLY worse
+        # than the incoming frame sheds -- an in-budget tenant must
+        # never shed its own frames just because the engine is busy
+        # (the stage credits bound its memory; blocking is the right
+        # backpressure there).  With no worse victim, the incoming
+        # frame itself sheds only when ITS tenant is over budget.
+        budgets = self.qos.budget_snapshot()
+        incoming_key = self.qos.shed_key(frame, budgets)
+        victim, victim_stream, victim_key = None, stream, None
+        for other in self.streams.values():
+            for candidate in other.frames.values():
+                if candidate.stage_waiting is None:
+                    continue
+                key = self.qos.shed_key(candidate, budgets)
+                if key[:2] <= incoming_key[:2]:
+                    continue                # not strictly worse
+                if victim_key is None or key > victim_key:
+                    victim, victim_stream, victim_key = \
+                        candidate, other, key
+        if victim is None:
+            if not incoming_key[0]:         # in budget: admit
+                return False
+            victim, victim_stream = frame, stream
+        self.qos.count_shed(victim.tenant)
+        if self.telemetry is not None:
+            # Resolved entry name, not the raw string: label
+            # cardinality stays bounded by LAZY_TENANT_CAP.
+            self.telemetry.registry.count(
+                "qos_sheds", tenant=self.qos.tenant(victim.tenant).name,
+                cls=str(victim.qos_class))
+        self._qos_sheds += 1
+        self.share["qos_sheds"] = self._qos_sheds
+        if victim is frame:
+            return True
+        self._count_shed(victim_stream)
+        victim.metrics["shed"] = True
+        self._rec("shed", victim_stream.stream_id, victim.frame_id,
+                  info={"policy": "qos", "tenant": victim.tenant,
+                        "cls": victim.qos_class})
+        self._frame_fail(
+            victim_stream, victim,
+            f"shed: qos overload ({self.qos.inflight_total} in "
+            f"flight, tenant {victim.tenant})")
+        return False
+
+    def _note_promotion(self, stream_id, frame: Frame) -> None:
+        """A frame's near-deadline promotion decided a waiter pop
+        (StageScheduler ``on_promote``, fired once per frame): count
+        it and put it on the ring next to the admit it caused."""
+        self._qos_promotions += 1
+        self.share["qos_promotions"] = self._qos_promotions
+        if self.telemetry is not None:
+            self.telemetry.registry.count(
+                "qos_promotions", cls=str(frame.qos_class))
+        self._rec("gw_promote", stream_id, frame.frame_id,
+                  frame.qos_class,
+                  info={"tenant": frame.tenant})
+
+    def qos_stats(self) -> dict:
+        """The QoS plane's live view: per-tenant budgets/in-flight/
+        shed counters plus the promotion total (None-safe)."""
+        if self.qos is None:
+            return {"enabled": False}
+        stats = self.qos.stats()
+        stats["enabled"] = True
+        stats["promotions_recorded"] = self._qos_promotions
+        stats["sheds_recorded"] = self._qos_sheds
+        return stats
+
     def _stamp_deadline(self, stream: Stream, frame: Frame) -> None:
         if not stream.deadline_ms:
             return
@@ -2104,6 +2282,25 @@ class Pipeline(Actor):
                 "overload_limit",
                 self._pipeline_parameters.get("overload_limit")),
             OVERLOAD_LIMIT_DEFAULT))
+        # Unified QoS admission (ISSUE 12): tenant identity + priority
+        # class resolve once per stream (gateway sessions set them;
+        # anything else lands on the default tenant's class).  An
+        # unknown class falls back rather than erroring -- the gateway
+        # validates client input at ITS boundary; a local caller's
+        # typo must not kill the stream.
+        stream.tenant = str(stream.parameters.get("tenant", "default"))
+        requested_class = stream.parameters.get("qos_class")
+        if self.qos is not None:
+            resolved = self.qos.resolve_class(requested_class,
+                                              stream.tenant)
+            if requested_class is not None \
+                    and str(requested_class) != resolved:
+                self.logger.warning(
+                    "stream %s: qos_class=%r unknown; using %s",
+                    stream_id, requested_class, resolved)
+            stream.qos_class = resolved
+        elif requested_class is not None:
+            stream.qos_class = str(requested_class)
         if grace_time:
             stream.lease = Lease(
                 self.runtime.engine, float(grace_time), stream_id,
@@ -2197,6 +2394,7 @@ class Pipeline(Actor):
         # to the window (and wake other streams' queued frames); queued
         # tokens for dead frames are skipped lazily when popped.
         for frame in list(stream.frames.values()):
+            self._qos_done(frame)
             self._release_stage(stream, frame)
         # Completed frames' responses still buffered behind an
         # in-flight predecessor: deliver them (best-effort seq order)
@@ -2283,7 +2481,9 @@ class Pipeline(Actor):
         if self.telemetry is not None:
             self.telemetry.frame_started(frame)
         self._rec("ingest", stream.stream_id, frame.frame_id)
-        shed = self._shed_for_overload(stream)
+        self._stamp_qos(stream, frame)
+        shed = self._shed_for_overload(stream) \
+            or self._qos_shed_for_overload(stream, frame)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
         if shed:
@@ -2292,8 +2492,9 @@ class Pipeline(Actor):
         self._stamp_deadline(stream, frame)
         # Bounded dispatch window: before this frame's device work
         # enqueues, sync the oldest completed-but-unsynced frame(s) so
-        # dispatch stays at most device_inflight frames ahead.
-        paced = stream.device_window.pace(stream.device_inflight)
+        # dispatch stays at most device_inflight frames ahead
+        # (per-class caps apply -- QoS plane 1).
+        paced = stream.device_window.pace(self._device_limit(stream))
         if paced:
             self._note_pace(stream, frame, paced)
         self._process_frame_common(stream, frame)
@@ -2325,17 +2526,20 @@ class Pipeline(Actor):
             # A wire caller re-ingested a live frame id: the replaced
             # frame's delivery slot (and stage credit) must not wedge
             # the stream's reorder buffer / admission window.
+            self._qos_done(stale)
             self._release_stage(stream, stale)
             self._deliver(stream, stale, okay=False, skip=True)
         self._rec("ingest", stream.stream_id, frame.frame_id)
-        shed = self._shed_for_overload(stream)
+        self._stamp_qos(stream, frame)
+        shed = self._shed_for_overload(stream) \
+            or self._qos_shed_for_overload(stream, frame)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
         if shed:
             self._shed_incoming(stream, frame)
             return
         self._stamp_deadline(stream, frame)
-        paced = stream.device_window.pace(stream.device_inflight)
+        paced = stream.device_window.pace(self._device_limit(stream))
         if paced:
             self._note_pace(stream, frame, paced)
         self._process_frame_common(stream, frame)
@@ -2381,6 +2585,7 @@ class Pipeline(Actor):
             # pipeline-global -- leaking here would wedge EVERY stream
             # at that stage) and consume its delivery slot.
             stream.frames.pop(frame.frame_id, None)
+            self._qos_done(frame)
             self._release_stage(stream, frame)
             self._deliver(stream, frame, okay=False, skip=True)
             return
@@ -2459,6 +2664,10 @@ class Pipeline(Actor):
                     # queued tokens from a destroyed same-id stream).
                     frame.stage_waiting = node.name
                     frame.stage_wait_start = time.perf_counter()
+                    # Aging clock for the QoS rank: how long THIS wait
+                    # has lasted, not time since ingest -- a frame that
+                    # just crossed a stage hasn't been starving.
+                    frame.qos_wait_start = time.monotonic()
                     self._rec("stage_wait", stream.stream_id,
                               frame.frame_id, node.name)
                     self.post_self("enter_stage_frame",
@@ -2934,8 +3143,13 @@ class Pipeline(Actor):
                                  f"dead (awaiting rebuild)")
                 return
             if group is not None:
+                # QoS plane 3: latency-sensitive classes take the
+                # least-loaded live replica instead of the cursor's
+                # round-robin next.
                 replica = scheduler.admit_replica(
-                    node_name, reserved=bool(from_queue))
+                    node_name, reserved=bool(from_queue),
+                    least_loaded=self.qos is not None
+                    and self.qos.latency_sensitive(frame.qos_class))
                 admitted = replica is not None
             else:
                 replica = None
@@ -3565,6 +3779,7 @@ class Pipeline(Actor):
             time.perf_counter() - frame.metrics["time_pipeline_start"])
         stream.last_frame_time = time.monotonic()   # grace lease clock
         stream.frames.pop(frame.frame_id, None)
+        self._qos_done(frame)
         self._rec("done", stream.stream_id, frame.frame_id,
                   ms=frame.metrics["time_pipeline"] * 1000.0,
                   info={"ok": True})
@@ -3676,6 +3891,7 @@ class Pipeline(Actor):
     def _finish_failed_frame(self, stream: Stream, frame: Frame,
                              diagnostic: str):
         stream.frames.pop(frame.frame_id, None)
+        self._qos_done(frame)
         self._rec("done", stream.stream_id, frame.frame_id,
                   info={"ok": False, "error": str(diagnostic)[:200]})
         # ok=False: when the failed frame was a half-open replica's
@@ -3957,6 +4173,11 @@ class Pipeline(Actor):
     def stop(self):
         self._cancel_health_timer()
         self.disarm_faults()
+        if self.gateway is not None:
+            # Before streams: a live WebSocket session must stop
+            # feeding frames before its stream tears down under it.
+            self.gateway.stop()
+            self.gateway = None
         for stream_id in list(self.streams):
             self._destroy_stream_now(stream_id)
         if self.stage_scheduler is not None:

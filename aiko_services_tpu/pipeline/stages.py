@@ -179,11 +179,39 @@ class ReplicaGroup:
                 free += 1
         return free
 
-    def pick(self) -> int | None:
+    def pick(self, least_loaded: bool = False) -> int | None:
         """Next replica to admit into (round-robin over live slots with
         a free credit; a half-open slot admits exactly one canary), or
-        None when every slot is full/dead."""
+        None when every slot is full/dead.  ``least_loaded`` (the QoS
+        plane, ISSUE 12: latency-sensitive classes) picks the live
+        slot with the fewest in-flight frames instead of the cursor's
+        next -- head-of-line latency over round-robin fairness; the
+        canary discipline is unchanged (a half-open slot only ever
+        admits through the round-robin walk below)."""
         count = len(self.states)
+        if least_loaded:
+            # A canary-ready half-open slot is probed FIRST: under
+            # pure latency-sensitive traffic the least-loaded branch
+            # would otherwise always find a free live credit and the
+            # rebuilt replica would stay half-open (N-1 capacity)
+            # until a saturation burst -- closing it back to live is
+            # what latency-sensitive traffic needs most.
+            for index in range(count):
+                if self.states[index] == REPLICA_HALF_OPEN \
+                        and not self.canary_inflight[index] \
+                        and self.active[index] == 0:
+                    self._rr = index + 1
+                    return index
+            best = None
+            for index in range(count):
+                if self.states[index] == REPLICA_LIVE \
+                        and self.active[index] < self.depth \
+                        and (best is None
+                             or self.active[index] < self.active[best]):
+                    best = index
+            if best is not None:
+                self._rr = best + 1
+                return best
         for offset in range(count):
             index = (self._rr + offset) % count
             state = self.states[index]
@@ -309,9 +337,19 @@ class StageScheduler:
     """
 
     def __init__(self, stages, depth: int = STAGE_INFLIGHT_DEFAULT,
-                 replicas: dict | None = None):
+                 replicas: dict | None = None, qos=None,
+                 on_promote=None):
         self.depth = max(1, int(depth))
         self.stages = list(stages)
+        # Unified QoS admission (ISSUE 12): when the pipeline carries a
+        # QosScheduler, waiter pops rank by (class, ingest seq) instead
+        # of FIFO -- an interactive frame overtakes queued batch frames
+        # at the credit window, the second of the four former admission
+        # planes.  ``on_promote(stream_id, frame)`` fires the first
+        # time a frame's near-deadline promotion decides a pop (the
+        # engine records/counts it).
+        self._qos = qos
+        self._on_promote = on_promote
         # Replicated stages (ISSUE 7): stage -> ReplicaGroup.  The
         # group owns per-replica credits; the per-stage counters below
         # keep tracking the TOTAL so occupancy/stats stay uniform.
@@ -376,20 +414,21 @@ class StageScheduler:
         if self._active[stage] == 1:
             self._busy_since[stage] = time.monotonic()
 
-    def admit_replica(self, stage: str, reserved: bool = False) \
-            -> int | None:
+    def admit_replica(self, stage: str, reserved: bool = False,
+                      least_loaded: bool = False) -> int | None:
         """Replicated-stage admission: returns the replica index the
         frame admits into (round-robin over live slots with a free
-        per-replica credit), or None when the group is full.  The
-        reservation discipline mirrors ``try_admit`` -- a fresh attempt
-        may only take capacity beyond the credits promised to popped
-        waiter tokens."""
+        per-replica credit; ``least_loaded`` for latency-sensitive QoS
+        classes), or None when the group is full.  The reservation
+        discipline mirrors ``try_admit`` -- a fresh attempt may only
+        take capacity beyond the credits promised to popped waiter
+        tokens."""
         group = self.groups[stage]
         if reserved:
             self.cancel_reservation(stage)
         elif group.free_slots() <= self._reserved.get(stage, 0):
             return None
-        index = group.pick()
+        index = group.pick(least_loaded=least_loaded)
         if index is None:
             return None
         group.admit(index)
@@ -439,12 +478,40 @@ class StageScheduler:
         """Pop the next waiter when an unreserved credit is available
         (used both on release and when a popped waiter turned out
         dead); the popped token takes a reservation on that credit
-        until its admission post lands."""
+        until its admission post lands.  Without a QosScheduler the
+        pop is FIFO exactly as before; with one it picks the
+        best-ranked waiter -- (effective class, ingest seq), so
+        priority reorders across streams while same-class tokens keep
+        arrival order and a front-requeued token (stolen credit) still
+        wins its class on the seq tiebreak."""
         waiters = self._waiters.get(stage)
         if waiters and self._has_capacity(stage):
             self._reserved[stage] = self._reserved.get(stage, 0) + 1
+            if self._qos is not None and len(waiters) > 1:
+                return self._pop_ranked(waiters)
             return waiters.popleft()
         return None
+
+    def _pop_ranked(self, waiters: deque):
+        """Remove and return the best-ranked waiter token (tokens are
+        ``[stream_id, frame_id, node_name, True, frame]`` lists; the
+        Frame rides last).  Promotion decisions surface through
+        ``on_promote`` exactly once per frame."""
+        now = time.monotonic()
+        best_index, best_rank = 0, None
+        for index, token in enumerate(waiters):
+            frame = token[-1]
+            promoted_before = getattr(frame, "qos_promoted", False)
+            rank = self._qos.rank_frame(frame, now)
+            if not promoted_before \
+                    and getattr(frame, "qos_promoted", False) \
+                    and self._on_promote is not None:
+                self._on_promote(token[0], frame)
+            if best_rank is None or rank < best_rank:
+                best_index, best_rank = index, rank
+        token = waiters[best_index]
+        del waiters[best_index]
+        return token
 
     def waiting(self, stage: str) -> int:
         return len(self._waiters.get(stage, ()))

@@ -131,6 +131,20 @@ class Frame:
     # node NOT in here -- everything before it is host-visible in the
     # swag and must not re-execute.
     completed: set = field(default_factory=set)
+    # Unified QoS admission (ISSUE 12, gateway/qos.py): tenant + class
+    # resolved from the stream at ingest, the global ingest sequence
+    # (the rank tiebreak that preserves arrival order within a class),
+    # when the frame last started WAITING at an admission seam (aging
+    # input), whether the near-deadline promotion already fired (it is
+    # counted once), and whether the QosScheduler's in-flight
+    # accounting is open for this frame (closed exactly once on any
+    # completion path).
+    tenant: str | None = None
+    qos_class: str | None = None
+    qos_seq: int = 0
+    qos_wait_start: float | None = None
+    qos_promoted: bool = False
+    qos_open: bool = False
 
 
 @dataclass
@@ -181,6 +195,14 @@ class Stream:
     deadline_ms: float = 0.0
     overload_policy: str = "block"
     overload_limit: int = 0
+    # Unified QoS admission (ISSUE 12): the stream's tenant identity
+    # and priority class, resolved once at creation (gateway sessions
+    # set them via stream parameters; CLI/local streams default to
+    # the default tenant's class).  Every frame of a stream inherits
+    # them, which is what makes priority reorder across streams but
+    # never within one.
+    tenant: str = "default"
+    qos_class: str = "standard"
 
     def next_frame_id(self) -> int:
         frame_id = self.frame_count
