@@ -166,6 +166,32 @@ PIPELINE_PARAMETERS: dict[str, ParamSpec] = {
         "promote_ms, age_ms, max_inflight, session_window} (dict or "
         "JSON) -- the ONE admission authority every plane consults",
         kind="json"),
+    # -- process-level fault domain (ISSUE 13) -------------------------
+    "journal": ParamSpec(
+        "durable stream journal: per-stream recoverable state at "
+        "commit points, so a peer can adopt this pipeline's live "
+        "streams after process death (needs a writable journal_dir "
+        "-- on with none is a create-time DefinitionError)",
+        choices=("on", "off", "true", "false", "0", "1")),
+    "journal_dir": ParamSpec(
+        "directory holding <pipeline>.journal files; shared across "
+        "the fleet so survivors can re-read a dead peer's journal"),
+    "journal_fsync_ms": ParamSpec(
+        "batched-fsync interval for journal appends (0 = fsync every "
+        "record)", number=True, minimum=0),
+    "adopt_limit": ParamSpec(
+        "streams one adopt command reconstructs from a dead peer's "
+        "journal (the replay_limit discipline applied to adoption)",
+        number=True, minimum=1),
+    "drain_timeout_ms": ParamSpec(
+        "how long drain waits for in-flight frames before parking "
+        "the leftovers in the journal for adoption",
+        number=True, minimum=0),
+    "session_idle_ms": ParamSpec(
+        "gateway idle-session reaping: a session with no client "
+        "activity (frames/pongs) for this long frees its stream, "
+        "window slots and QoS budget (0 = never reap)",
+        number=True, minimum=0),
 }
 
 

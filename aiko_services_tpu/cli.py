@@ -215,7 +215,11 @@ def pipeline_create(definition_pathname, transport, name, stream_id,
                         "frame data must be an S-expression dictionary, "
                         "e.g. '(x: 1)'")
                 instance.create_frame_local(stream, data)
-        runtime.run()
+        # A drained pipeline retires its process: the rolling-restart
+        # driver (and any supervisor) respawns it fresh (ISSUE 13).
+        runtime.run(until=lambda: instance.share.get("drained"))
+        if instance.share.get("drained"):
+            click.echo("pipeline drained; exiting")
     finally:
         if profiler is not None:
             profiler.detach()
@@ -320,6 +324,104 @@ def pipeline_update(name, transport, parameters, stream_id, frame_data,
             proxy.process_frame({"stream_id": stream_id or "1"}, data)
 
     _with_named_pipeline(name, transport, timeout, send_update, "update")
+
+
+@pipeline.command("drain")
+@click.argument("name")
+@_transport_option
+@click.option("--timeout", default=3.0, help="discovery wait seconds")
+def pipeline_drain(name, transport, timeout):
+    """Cooperatively drain the named pipeline (ISSUE 13): admission
+    stops, in-flight work finishes or parks in the durable journal,
+    then the service announces its death so a peer adopts its streams
+    -- zero frame drop.  Requires ``journal: on`` for the handoff to
+    carry state."""
+    _with_named_pipeline(name, transport, timeout,
+                         lambda runtime, proxy: proxy.drain(), "drain")
+
+
+@pipeline.command("restart")
+@click.option("--name", default="*",
+              help="pipeline name to restart (default: every pipeline)")
+@_transport_option
+@click.option("--rolling", is_flag=True, required=True,
+              help="drain pipelines ONE AT A TIME, waiting for each "
+                   "to hand off and exit before touching the next -- "
+                   "with journaled streams and a peer to adopt them, "
+                   "a zero-frame-drop fleet restart (weight swaps "
+                   "included)")
+@click.option("--timeout", default=30.0,
+              help="seconds to wait for each drain to complete")
+def pipeline_restart(name, transport, rolling, timeout):
+    """Rolling restart: drain each matching pipeline in sequence.
+    Each drain parks undelivered work in the journal and exits; the
+    gateway re-binds its sessions to a surviving peer, which adopts
+    the journal -- so the fleet serves through the whole walk.  Your
+    supervisor (systemd/k8s/the chaos driver) restarts the drained
+    processes; the refreshed instance rejoins the peer pool and the
+    next drain can hand off to it."""
+    import time as time_module
+
+    from .pipeline import PROTOCOL_PIPELINE
+    from .services import ServiceFilter
+    from .services.share import services_cache_singleton
+
+    runtime = _runtime(transport)
+    cache = services_cache_singleton(runtime)
+    runtime.run(until=lambda: cache.state == "ready", timeout=5.0)
+    service_filter = ServiceFilter(protocol=PROTOCOL_PIPELINE) \
+        if name == "*" else ServiceFilter(name=name,
+                                          protocol=PROTOCOL_PIPELINE)
+    records = cache.registry.query(service_filter)
+    if not records:
+        click.echo(f"no pipelines matching {name!r}", err=True)
+        sys.exit(1)
+    all_pipelines = ServiceFilter(protocol=PROTOCOL_PIPELINE)
+
+    def peers_of(record):
+        return [entry for entry in
+                cache.registry.query(all_pipelines)
+                if entry.topic_path != record.topic_path]
+
+    walked = 0
+    for record in records:
+        if not peers_of(record):
+            # Draining the last live pipeline strands its sessions
+            # and leaves its journal unadopted -- refuse, like
+            # replay_limit refuses unbounded replays.
+            click.echo(f"  refusing to drain {record.name}: no live "
+                       f"peer to adopt its streams (respawn one "
+                       f"first)", err=True)
+            continue
+        click.echo(f"draining {record.name} ({record.topic_path})")
+        runtime.message.publish(f"{record.topic_path}/in", "(drain)")
+        deadline = time_module.monotonic() + timeout
+        gone = lambda: cache.registry.get(record.topic_path) is None
+        runtime.run(until=gone,
+                    timeout=max(0.1, deadline - time_module.monotonic()))
+        if not gone():
+            click.echo(f"  {record.name} still serving after "
+                       f"{timeout:.0f}s (journal off, or frames "
+                       f"wedged past drain_timeout_ms)", err=True)
+            continue
+        walked += 1
+        click.echo(f"  {record.name} drained and retired")
+        # Wait for the supervisor's respawn to REJOIN before touching
+        # the next pipeline: draining onward while the fleet is a
+        # peer short risks a no-survivor handoff at the next step.
+        rejoined = lambda: any(
+            entry.name == record.name for entry in
+            cache.registry.query(all_pipelines))
+        runtime.run(until=rejoined, timeout=timeout)
+        if rejoined():
+            click.echo(f"  {record.name} respawned and rejoined")
+        else:
+            click.echo(f"  warning: no respawn of {record.name} "
+                       f"within {timeout:.0f}s; continuing (next "
+                       f"drain is refused unless a peer remains)",
+                       err=True)
+    click.echo(f"rolling restart: {walked}/{len(records)} "
+               f"pipeline(s) walked")
 
 
 @pipeline.command("validate")
@@ -693,6 +795,40 @@ def video_to_images_cmd(video, pattern):
 
     frames = video_to_images(video, pattern)
     click.echo(json.dumps({"frames": frames, "pattern": pattern}))
+
+
+# -- chaos ------------------------------------------------------------------
+
+@main.command()
+@click.option("--pipelines", default=2,
+              help="pipeline processes to spawn (>= 2 so adoption has "
+                   "a survivor)")
+@click.option("--frames", default=12, help="frames the session streams")
+@click.option("--mode", type=click.Choice(["kill", "rolling"]),
+              default="kill",
+              help="kill: SIGKILL one pipeline mid-stream and assert "
+                   "adoption; rolling: drain+respawn every pipeline "
+                   "in sequence and assert zero drops")
+@click.option("--hang-ms", default=0.0,
+              help="SIGSTOP the victim this long before the kill "
+                   "(process_hang, kill mode only)")
+@click.option("--busy-ms", default=60.0, help="per-stage busy time")
+@click.option("--timeout", default=180.0, help="overall deadline")
+def chaos(pipelines, frames, mode, hang_ms, busy_ms, timeout):
+    """Multi-process chaos driver (ISSUE 13): native MQTT broker +
+    registrar + N pipeline processes sharing a journal directory, a
+    standalone gateway in THIS process, and a live WebSocket session
+    streaming through the fleet while pipelines die (SIGKILL) or
+    drain under it.  Asserts in-order, duplicate-free, zero-drop
+    delivery across the failover."""
+    from .faults.chaos import run_chaos
+
+    result = run_chaos(pipelines=pipelines, frames=frames, mode=mode,
+                       hang_ms=hang_ms, busy_ms=busy_ms,
+                       timeout=timeout, echo=click.echo)
+    if not result.get("ok"):
+        raise click.ClickException(f"chaos walk failed: {result}")
+    click.echo("chaos walk passed")
 
 
 # -- broker -----------------------------------------------------------------

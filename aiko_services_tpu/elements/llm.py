@@ -424,9 +424,27 @@ class LLM(PipelineElement):
                     0 if qos is None
                     else qos.class_rank(getattr(stream, "qos_class",
                                                 None)))
+        # Process fault domain (ISSUE 13): the frame identity keys the
+        # journal's per-token commits, and an adopted frame's journaled
+        # committed prefix resumes generation instead of re-running it.
+        pipeline = getattr(self, "pipeline", None)
+        frame = None
+        current = getattr(pipeline, "current_frame", None)
+        if callable(current):
+            frame = current()
+        journal_key = None
+        resume = None
+        if frame is not None:
+            if getattr(pipeline, "journal", None) is not None \
+                    and getattr(stream, "journal", False):
+                journal_key = (str(stream.stream_id),
+                               int(frame.frame_id))
+            take = getattr(pipeline, "take_journal_resume", None)
+            if callable(take):
+                resume = take(stream.stream_id, frame.frame_id)
         self._work.put(("request", str(stream.stream_id), text, complete,
                         self._resolve_request_params(), model_params,
-                        qos_info))
+                        qos_info, journal_key, resume))
 
     def stop_stream(self, stream, stream_id):
         """Cancel the stream's outstanding requests: a frame parked here
@@ -438,6 +456,16 @@ class LLM(PipelineElement):
         if self._work is not None:
             self._work.put(("cancel", f"{stream.stream_id}/"))
         return StreamEvent.OKAY, {}
+
+    def drain_requests(self):
+        """Migrate-in-place for ``Pipeline.drain`` (ISSUE 13): cancel
+        every live request (committed prefixes are already journaled
+        token by token) and drop the parked frames without responding,
+        leaving them undelivered in the journal -- the adopting peer
+        replays each frame and its LLM request resumes at the
+        committed prefix via ``ContinuousBatcher.resume_request``."""
+        if self._work is not None:
+            self._work.put(("drain",))
 
     # -- device worker -----------------------------------------------------
 
@@ -454,7 +482,7 @@ class LLM(PipelineElement):
         and is swallowed -- one bad frame must not strand the others."""
         if item[0] == "request":
             (_, stream_id, text, complete, request_params, model_params,
-             qos_info) = item
+             qos_info, journal_key, resume) = item
             try:
                 self._ensure_model(model_params)
                 request, collected = self._make_request(
@@ -467,9 +495,18 @@ class LLM(PipelineElement):
                          {"diagnostic": f"llm: {error}"})
                 return
             tokenizer, inner_emit = self._tokenizer, request.emit
+            journal = getattr(self.pipeline, "journal", None) \
+                if journal_key is not None else None
 
             def emit(request_id, token, finished):
                 inner_emit(request_id, token, finished)
+                if journal is not None:
+                    # Committed-prefix commit point (ISSUE 13): every
+                    # emitted token becomes durable, so an adopter
+                    # resumes generation exactly here.  Worker-thread
+                    # safe; the fsync is batched.
+                    journal.llm_token(journal_key[0], journal_key[1],
+                                      int(token))
                 if finished:
                     self._completes.pop(request_id, None)
                     complete(StreamEvent.OKAY,
@@ -478,6 +515,35 @@ class LLM(PipelineElement):
             request.emit = emit
             self._completes[request.request_id] = complete
             self._batcher.submit(request)
+            if resume:
+                # Adopted frame: fold the journaled committed prefix
+                # in (prompt + committed re-prefill, budget arithmetic
+                # preserved) and pre-seed the collector, so the final
+                # text is byte-identical to an uninterrupted run at
+                # temperature 0 -- tokens already streamed are never
+                # re-generated.
+                eos = set(self._tokenizer.eos_tokens)
+                collected.extend(int(token) for token in resume
+                                 if int(token) not in eos)
+                if not self._batcher.resume_request(request, resume):
+                    # The prefix already finished the request (the
+                    # process died between the final emit and
+                    # delivery): complete from the committed tokens
+                    # -- resuming would decode a spurious tail.
+                    self._completes.pop(request.request_id, None)
+                    complete(StreamEvent.OKAY,
+                             {"text": tokenizer.decode(collected)})
+        elif item[0] == "drain":
+            # Cooperative drain (ISSUE 13): every live request's
+            # committed prefix is already journaled per token; cancel
+            # them and DROP the parked frames -- no response is sent
+            # (the adopter's replay is the response), so the client
+            # sees each result exactly once, from the peer.
+            completes, self._completes = self._completes, {}
+            for request_id, complete in completes.items():
+                if self._batcher is not None:
+                    self._batcher.cancel(request_id)
+                complete(StreamEvent.DROP_FRAME, {})
         else:                           # ("cancel", stream prefix)
             prefix = item[1]
             for request_id in [rid for rid in self._completes

@@ -1,0 +1,240 @@
+"""Multi-process chaos driver (ISSUE 13 satellite): realize the
+``process_kill`` / ``process_hang`` fault points as REAL signals
+against real OS processes.
+
+``python -m aiko_services_tpu chaos`` spawns a native MQTT broker, a
+registrar, and N pipeline processes sharing one journal directory,
+then runs a standalone gateway IN THIS process and drives a live
+WebSocket session through the fleet while killing (or draining)
+pipelines under it:
+
+- ``--mode kill``     SIGKILL one pipeline mid-stream.  Its broker
+  connection dies without a DISCONNECT, the broker fires the
+  process-level LWT, the registrar reaps it, the gateway re-binds the
+  session to a surviving peer, and the peer adopts the dead
+  pipeline's journal -- the session's results resume in order with no
+  duplicates.
+- ``--mode rolling``  drain every pipeline in sequence (respawning
+  each before draining the next): the zero-frame-drop rolling
+  restart, under open-loop load.
+- ``--hang-ms N``     (with kill) SIGSTOP the victim for N ms first
+  -- a wedged-but-alive process -- then SIGKILL it.
+
+The in-process twin of this walk (same engine seams, loopback broker,
+``Pipeline.kill()``) runs in tier-1: ``tests/test_failover.py``.
+This driver is the ``slow``-marked full-fidelity version: real
+processes, real signals, a real TCP broker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from ..utils import get_logger
+
+__all__ = ["run_chaos"]
+
+_logger = get_logger("aiko.chaos")
+
+_STAGE_MODULE = "aiko_services_tpu.elements.common"
+
+
+def _definition(name: str, journal_dir: str, busy_ms: float) -> dict:
+    def stage(stage_name, factor):
+        return {"name": stage_name, "input": [{"name": "x"}],
+                "output": [{"name": "x"}],
+                "parameters": {"busy_ms": busy_ms, "factor": factor},
+                "placement": {"devices": 2},
+                "deploy": {"local": {"module": _STAGE_MODULE,
+                                     "class_name": "StageWork"}}}
+    return {"version": 0, "name": name, "runtime": "jax",
+            "graph": ["(work finish)"],
+            "parameters": {"journal": "on", "journal_dir": journal_dir,
+                           "drain_timeout_ms": 2000},
+            "elements": [stage("work", 2.0), stage("finish", 3.0)]}
+
+
+def _spawn_pipeline(name: str, definition_path: str, env: dict,
+                    log_dir: str) -> subprocess.Popen:
+    log = open(os.path.join(log_dir, f"{name}.log"), "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "aiko_services_tpu", "pipeline",
+         "create", definition_path, "-t", "mqtt", "--name", name],
+        env=env, stdout=log, stderr=log, start_new_session=True)
+
+
+def run_chaos(pipelines: int = 2, frames: int = 12,
+              mode: str = "kill", busy_ms: float = 60.0,
+              hang_ms: float = 0.0, timeout: float = 180.0,
+              echo=print) -> dict:
+    """Run the multi-process chaos walk; returns a result dict with
+    ``ok`` plus the delivery/failover evidence.  Raises RuntimeError
+    when the fleet cannot come up (no compiler for the broker, ...)."""
+    from ..gateway.client import GatewayClient
+    from ..gateway.server import GatewayServer
+    from ..runtime import init_process, reset_process
+    from ..transport.broker import BrokerProcess
+
+    assert mode in ("kill", "rolling"), mode
+    workdir = tempfile.mkdtemp(prefix="aiko_chaos_")
+    journal_dir = os.path.join(workdir, "journals")
+    os.makedirs(journal_dir, exist_ok=True)
+    children: dict[str, subprocess.Popen] = {}
+    broker = None
+    runtime = None
+    gateway = None
+    result = {"ok": False, "mode": mode, "workdir": workdir}
+    try:
+        broker = BrokerProcess(port=0, export_env=True).start()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault(
+            "XLA_FLAGS",
+            "--xla_force_host_platform_device_count=8")
+        echo(f"broker :{broker.port}; journals in {journal_dir}")
+
+        registrar_log = open(os.path.join(workdir, "registrar.log"),
+                             "w")
+        children["registrar"] = subprocess.Popen(
+            [sys.executable, "-m", "aiko_services_tpu", "registrar",
+             "-t", "mqtt"], env=env, stdout=registrar_log,
+            stderr=registrar_log, start_new_session=True)
+
+        names = [f"chaos{index + 1}" for index in range(pipelines)]
+        for name in names:
+            path = os.path.join(workdir, f"{name}.json")
+            with open(path, "w") as stream:
+                json.dump(_definition(name, journal_dir, busy_ms),
+                          stream)
+            children[name] = _spawn_pipeline(name, path, env, workdir)
+
+        runtime = init_process(transport="mqtt")
+        runtime.initialize()
+        gateway = GatewayServer(runtime=runtime)
+        deadline = time.monotonic() + timeout
+
+        def wait_for(predicate, what):
+            runtime.run(until=predicate,
+                        timeout=max(1.0,
+                                    deadline - time.monotonic()))
+            if not predicate():
+                raise RuntimeError(f"timed out waiting for {what}")
+
+        wait_for(lambda: len(gateway._peers) == pipelines,
+                 f"{pipelines} pipeline processes (see {workdir})")
+        echo(f"fleet up: {sorted(gateway._peers.values())}")
+
+        client = GatewayClient("127.0.0.1", gateway.port,
+                               timeout=timeout)
+        results: list = []
+        errors: list = []
+
+        def drive():
+            try:
+                client.open(session="chaos", tenant="t1")
+                for index in range(frames):
+                    client.send_frame({"x": [float(index + 1)] * 4})
+                    results.append(client.next_result(timeout=60.0))
+                client.close()
+            except Exception as error:       # surfaced below
+                errors.append(error)
+
+        driver = threading.Thread(target=drive, daemon=True)
+        driver.start()
+        wait_for(lambda: len(results) >= 2 or errors,
+                 "first results")
+
+        if mode == "kill":
+            # Kill the pipeline the session is BOUND to (discovery
+            # order decides the binding, so sorting by name would
+            # sometimes kill the idle peer and prove nothing).
+            session = gateway.sessions.get("chaos")
+            bound = gateway._peers.get(session.target) \
+                if session is not None and session.target else None
+            victim_name = bound or sorted(gateway._peers.values())[0]
+            victim = children[victim_name]
+            if hang_ms > 0:
+                echo(f"SIGSTOP {victim_name} (pid {victim.pid}) "
+                     f"for {hang_ms:.0f} ms [process_hang]")
+                victim.send_signal(signal.SIGSTOP)
+                time.sleep(hang_ms / 1000.0)
+                victim.send_signal(signal.SIGCONT)
+            echo(f"SIGKILL {victim_name} (pid {victim.pid}) "
+                 f"mid-stream [process_kill]")
+            victim.kill()
+            victim.wait(10.0)
+            wait_for(lambda: gateway.failovers >= 1 or errors,
+                     "LWT -> failover")
+            echo(f"failover: sessions re-bound "
+                 f"(failovers={gateway.failovers})")
+        else:                               # rolling
+            for name in sorted(children):
+                if name == "registrar":
+                    continue
+                topic = next((t for t, n in gateway._peers.items()
+                              if n == name), None)
+                if topic is None:
+                    echo(f"skip {name}: not in the peer pool "
+                         f"(never joined or already gone)")
+                    continue
+                echo(f"drain {name} [rolling restart]")
+                runtime.message.publish(f"{topic}/in", "(drain)")
+                wait_for(lambda: topic not in gateway._peers
+                         or errors, f"{name} to drain away")
+                children[name].wait(15.0)
+                # respawn: the refreshed instance rejoins the pool
+                # (its journal starts a fresh incarnation -- the
+                # drained state was already adopted by a peer)
+                path = os.path.join(workdir, f"{name}.json")
+                children[name] = _spawn_pipeline(name, path, env,
+                                                 workdir)
+                wait_for(lambda: any(n == name for n in
+                                     gateway._peers.values())
+                         or errors, f"{name} to rejoin")
+                echo(f"  {name} restarted and rejoined")
+
+        wait_for(lambda: not driver.is_alive(), "client completion")
+        if errors:
+            raise errors[0]
+        frame_ids = [entry["frame"] for entry in results]
+        ok_flags = [entry["ok"] for entry in results]
+        result.update({
+            "frames": frames, "delivered": len(results),
+            "in_order_no_dups": frame_ids == list(range(frames)),
+            "all_ok": all(ok_flags),
+            "failovers": gateway.failovers,
+            "dropped": frames - len(results)})
+        result["ok"] = bool(result["in_order_no_dups"]
+                            and result["all_ok"]
+                            and result["dropped"] == 0)
+        echo(f"delivered {len(results)}/{frames} in order="
+             f"{result['in_order_no_dups']} ok={result['all_ok']} "
+             f"dropped={result['dropped']} "
+             f"failovers={gateway.failovers}")
+        return result
+    finally:
+        if gateway is not None:
+            gateway.stop()
+        if runtime is not None:
+            try:
+                runtime.terminate()
+            except Exception:
+                pass
+            reset_process()
+        for name, child in children.items():
+            if child.poll() is None:
+                child.terminate()
+        for name, child in children.items():
+            try:
+                child.wait(5.0)
+            except subprocess.TimeoutExpired:
+                child.kill()
+        if broker is not None:
+            broker.stop()

@@ -43,6 +43,18 @@ Injection points (the ``point`` field of a rule):
 ``wire_delay``            deliver it ``delay_ms`` late
 ``wire_dup``              deliver it twice
 ``wire_corrupt``          mangle the payload (receiver's parse drops it)
+``process_kill``          the whole pipeline process dies uncleanly.
+                          In-process (tier-1): the engine's ingest seam
+                          consults it and calls ``Pipeline.kill()`` --
+                          streams drop with no responses, the retained
+                          ``(absent)`` state fires like an LWT, the
+                          journal is left as the crash left it.  The
+                          multi-process chaos driver (``python -m
+                          aiko_services_tpu chaos``) realizes it as a
+                          real SIGKILL.
+``process_hang``          the process stops making progress for
+                          ``delay_ms`` (in-process: the event loop
+                          sleeps; the chaos driver: SIGSTOP/SIGCONT)
 ========================  ==================================================
 
 ``target`` selects where: an element/stage/segment name for engine
@@ -70,6 +82,7 @@ POINTS = frozenset({
     "element_raise", "element_hang", "segment_fail", "stage_stall",
     "device_kill", "device_hang", "decode_block",
     "wire_drop", "wire_delay", "wire_dup", "wire_corrupt",
+    "process_kill", "process_hang",
 })
 
 WIRE_POINTS = ("wire_drop", "wire_delay", "wire_dup", "wire_corrupt")

@@ -29,6 +29,29 @@ loopback, no external broker); one daemon thread per connection plus
 one result pump per session -- the pump pays the ONE counted ledger
 fetch per result (the gateway is a wire sink under the device-resident
 swag contract, like ``_respond``'s process boundary).
+
+Process-level fault domain (ISSUE 13): sessions are DECOUPLED from any
+one pipeline.  Each session binds to a *target* -- the owning pipeline
+in-process (fast path) or any pipeline discovered via registrar
+records (wire path: ``create_stream``/``process_frame`` commands with
+the gateway's own response topic).  When a bound pipeline's LWT fires
+(registrar ``remove`` -> discovery ``_on_lost``), the gateway re-binds
+the affected sessions to a surviving peer and commands it to ``adopt``
+the dead pipeline's stream journal: the peer reconstructs the
+sessions' streams, replays undelivered frames, and results resume on
+the same WebSocket -- in order, deduped by the session-owned frame-id
+sequence (the gateway assigns every frame's id, so 'already delivered'
+means the same thing on every peer).  A standalone gateway
+(``pipeline=None`` + a runtime) is the same machinery with no local
+fast path: the production shape, where the front door's process is a
+separate fault domain from every serving pipeline.
+
+Idle-session reaping (``session_idle_ms``): a client that vanished
+without a FIN -- its host died, its NAT forgot the mapping -- must not
+pin a stream, its window slots and its tenant's in-flight budget until
+process exit.  The reaper pings idle sessions (RFC 6455 ping; any
+client speaking the shared codec pongs automatically) and frees the
+session when a full idle window passes with no frames and no pongs.
 """
 
 from __future__ import annotations
@@ -41,7 +64,7 @@ import uuid
 
 from . import ws
 from .qos import QosScheduler
-from ..utils import get_logger
+from ..utils import get_logger, generate, parse, parse_number
 
 __all__ = ["GatewayServer", "json_safe", "decode_data"]
 
@@ -49,6 +72,11 @@ _logger = get_logger("aiko.gateway")
 
 _HTTP_TIMEOUT_S = 30.0          # one-shot HTTP frame round trip
 _ACCEPT_BACKLOG = 128
+# Death -> adoption settle window: lets a DRAINING pipeline finish
+# journaling frames that were in flight toward it when it announced
+# its death, before the survivor reads the journal (see
+# _on_peer_lost).
+_FAILOVER_SETTLE_S = 0.08
 
 
 def decode_data(data: dict) -> dict:
@@ -148,6 +176,25 @@ class _Session:
         self.sent_times: list[float] = []   # FIFO; results are in-order
         self.closing = False
         self.pump: threading.Thread | None = None
+        # Process fault domain (ISSUE 13): which pipeline this session
+        # is bound to (None = the gateway's own pipeline, in-process;
+        # a topic path = the wire binding), the SESSION-owned frame-id
+        # sequence every target shares, and the last frame id actually
+        # delivered to the client -- the failover dedupe line (a
+        # replayed frame at or below it was already answered).
+        self.target: str | None = None
+        self.frame_seq = 0
+        self.last_delivered = -1
+        self.last_activity = time.monotonic()
+
+    def next_frame_id(self) -> int:
+        with self.state_lock:
+            frame_id = self.frame_seq
+            self.frame_seq += 1
+            return frame_id
+
+    def touch(self) -> None:
+        self.last_activity = time.monotonic()
 
     def take_slot(self) -> "float | None":
         """Reserve one window slot; returns the stamp to pass to
@@ -184,12 +231,18 @@ class _Session:
 
 
 class GatewayServer:
-    """Serve one pipeline's front door on ``host:port`` (0 = kernel-
-    assigned, echoed on ``.port``)."""
+    """Serve a front door on ``host:port`` (0 = kernel-assigned,
+    echoed on ``.port``) -- for one pipeline (``gateway: on``,
+    in-process fast path + failover to discovered peers) or standalone
+    (``pipeline=None`` with a ``runtime``: every session binds to a
+    discovered pipeline over the wire, so the gateway survives any
+    serving process's death)."""
 
-    def __init__(self, pipeline, host: str = "127.0.0.1",
-                 port: int = 0):
+    def __init__(self, pipeline=None, host: str = "127.0.0.1",
+                 port: int = 0, runtime=None,
+                 session_idle_ms: float = 0.0, name: str = "gateway"):
         self.pipeline = pipeline
+        self.name = name
         # Lazy default policy: the server may bind BEFORE the pipeline
         # finishes constructing (the endpoint is advertised as a
         # registrar tag, so it binds pre-registration like the tensor
@@ -200,6 +253,24 @@ class GatewayServer:
         self._sessions_lock = threading.Lock()
         self._http_seq = 0
         self._stopped = False
+        # Failover plane (ISSUE 13): discovered peer pipelines
+        # (topic_path -> service name), the wire-response plumbing,
+        # and the counters the failover tests assert on.
+        self.runtime = None
+        self._response_topic: str | None = None
+        self._discovery = None
+        self._peers: dict[str, str] = {}
+        self._peers_lock = threading.Lock()
+        self._http_waits: dict[str, object] = {}
+        # Failovers that found NO survivor wait here; the next
+        # _on_peer_found replays them, so sessions genuinely "stall
+        # until one appears" instead of stalling forever.
+        self._pending_failovers: list[tuple] = []
+        self.failovers = 0
+        self.sessions_reaped = 0
+        # Idle-session reaping (``session_idle_ms``; 0 = off).
+        self.session_idle_ms = max(0.0, float(session_idle_ms or 0.0))
+        self._reaper: threading.Thread | None = None
         self._sock = socket.create_server((host, int(port)),
                                           backlog=_ACCEPT_BACKLOG)
         self.host = host
@@ -208,8 +279,278 @@ class GatewayServer:
             target=self._accept_loop, daemon=True,
             name=f"gateway-accept-{self.port}")
         self._accept_thread.start()
+        if self.session_idle_ms:
+            self._reaper = threading.Thread(
+                target=self._reap_loop, daemon=True,
+                name=f"gateway-reaper-{self.port}")
+            self._reaper.start()
+        if runtime is not None:
+            self.attach_runtime(runtime)
         _logger.info("gateway front door on %s:%d (/v1/stream ws, "
                      "/v1/frames http)", host, self.port)
+
+    def attach_runtime(self, runtime) -> None:
+        """Join the service fabric: a private response topic for wire
+        frame results, plus discovery of every pipeline service --
+        the peer pool sessions fail over to (and bind to directly, in
+        standalone mode).  Called by the owning Pipeline AFTER its
+        actor registration (the gateway binds its socket before the
+        runtime exists), or at construction when standalone."""
+        if self.runtime is not None or runtime is None:
+            return
+        self.runtime = runtime
+        self._response_topic = \
+            f"{runtime.topic_path_process}/gateway/{self.port}"
+        runtime.add_message_handler(self._on_wire_response,
+                                    self._response_topic)
+        # Deferred import (cycle: pipeline -> gateway at bind time),
+        # but the ONE protocol authority -- a hand-copied literal here
+        # would silently match nothing if the version ever bumps.
+        from ..pipeline.pipeline import PROTOCOL_PIPELINE
+        from ..services import ServiceFilter, do_discovery
+        self._discovery = do_discovery(
+            runtime, ServiceFilter(protocol=PROTOCOL_PIPELINE),
+            add_handler=self._on_peer_found,
+            remove_handler=self._on_peer_lost)
+
+    # -- peer pool + failover ----------------------------------------------
+
+    def _home_topic(self) -> str | None:
+        pipeline = self.pipeline
+        return None if pipeline is None \
+            else getattr(pipeline, "topic_path", None)
+
+    def _home_alive(self) -> bool:
+        return self.pipeline is not None \
+            and not getattr(self.pipeline, "_killed", False) \
+            and not getattr(self.pipeline, "_draining", False) \
+            and not getattr(self.pipeline, "_drained", False)
+
+    def _on_peer_found(self, record, proxy) -> None:
+        if record.topic_path == self._home_topic():
+            return                      # the in-process fast path
+        with self._peers_lock:
+            self._peers[record.topic_path] = record.name
+        _logger.info("gateway: pipeline peer %s (%s)", record.name,
+                     record.topic_path)
+        if self._pending_failovers:
+            # Sessions stalled on an earlier no-survivor death: this
+            # peer is their survivor.  Re-run the completion (it
+            # re-computes the affected set; sessions that closed
+            # meanwhile drop out).
+            pending, self._pending_failovers = \
+                self._pending_failovers, []
+            for dead_topic, dead_name, home_died in pending:
+                self._complete_failover(dead_topic, dead_name,
+                                        home_died)
+
+    def _pick_target(self) -> "str | None":
+        """Binding for a NEW session: the in-process pipeline when it
+        is alive, else any discovered peer, else the empty sentinel
+        (no backend -- the open is refused)."""
+        if self._home_alive():
+            return None
+        with self._peers_lock:
+            for topic in self._peers:
+                return topic
+        return ""
+
+    def _on_peer_lost(self, record, proxy=None) -> None:
+        """A bound pipeline died (LWT -> registrar remove -> here) or
+        drained away: after a short settle window, re-bind its
+        sessions to a survivor and command the adoption of its
+        journal."""
+        topic = record.topic_path
+        home_died = topic == self._home_topic()
+        with self._peers_lock:
+            self._peers.pop(topic, None)
+        affected = [session for session in list(self.sessions.values())
+                    if (session.target == topic
+                        or (session.target is None and home_died))]
+        if not affected:
+            return
+        # Settle before adopting: a DRAINING pipeline is still
+        # journaling frames that were already in flight toward it
+        # when it announced its death (they are held for the adopter,
+        # not run).  Reading the journal immediately would race those
+        # stragglers -- the one frame the zero-drop contract would
+        # lose.  A killed pipeline journals nothing in the window, so
+        # the delay only costs MTTR.  Registrar CHURN also lands here
+        # (the mirror purges and fires a remove per record, pipelines
+        # not dead): give the re-share a full extra second, and let
+        # the completion's peer-is-back check turn it into a no-op --
+        # returning early instead used to skip a genuine death
+        # forever when the removal raced a cache refresh.
+        cache = getattr(self._discovery, "cache", None)
+        settle = _FAILOVER_SETTLE_S
+        if cache is not None and cache.state != "ready":
+            settle += 1.0
+        self.runtime.engine.add_oneshot_timer(
+            lambda: self._complete_failover(topic, record.name,
+                                            home_died), settle)
+
+    def _complete_failover(self, topic: str, dead_name: str,
+                           home_died: bool) -> None:
+        with self._peers_lock:
+            if topic in self._peers:
+                return              # churn, not death: peer re-added
+        affected = [session for session in list(self.sessions.values())
+                    if (session.target == topic
+                        or (session.target is None and home_died))]
+        if not affected:
+            return
+        survivor = None
+        with self._peers_lock:
+            for peer in self._peers:
+                survivor = peer
+                break
+        if survivor is None and not home_died and self._home_alive():
+            survivor = ""               # fail back to the local path
+        if survivor is None:
+            self._pending_failovers.append((topic, dead_name,
+                                            home_died))
+            _logger.error(
+                "gateway: pipeline %s died with %d bound session(s) "
+                "and no surviving peer; sessions stall until one "
+                "appears", dead_name, len(affected))
+            return
+        self.failovers += 1
+        telemetry = getattr(self.pipeline, "telemetry", None)
+        if telemetry is not None:
+            telemetry.registry.count("pipeline_failovers")
+        # Adoption FIRST, then re-bind: the peer's mailbox is FIFO, so
+        # the journal replay lands before any new frame the re-bound
+        # sessions send it.
+        self._send_adopt(survivor, dead_name)
+        for session in affected:
+            session.target = None if survivor == "" else survivor
+        _logger.warning(
+            "gateway: pipeline %s died; %d session(s) re-bound to %s "
+            "(journal adoption requested)", dead_name, len(affected),
+            "local pipeline" if survivor == "" else survivor)
+
+    def _send_adopt(self, survivor: str, dead_name: str) -> None:
+        if survivor == "" and self.pipeline is not None:
+            self.pipeline.post_self(
+                "adopt", [dead_name, self._response_topic])
+        elif self.runtime is not None:
+            self.runtime.message.publish(
+                f"{survivor}/in",
+                generate("adopt", [dead_name,
+                                   self._response_topic or ""]))
+
+    # -- wire binding ------------------------------------------------------
+
+    def _create_wire_stream(self, target: str, stream_id: str,
+                            parameters: dict) -> None:
+        self.runtime.message.publish(
+            f"{target}/in",
+            generate("create_stream", [stream_id, dict(parameters)]))
+
+    def _send_wire_frame(self, target: str, stream_id: str,
+                         frame_id: int, data: dict) -> None:
+        from ..pipeline.codec import encode_frame_data
+        header = {"stream_id": stream_id, "frame_id": int(frame_id),
+                  "response_topic": self._response_topic}
+        self.runtime.message.publish(
+            f"{target}/in",
+            generate("process_frame",
+                     [header, encode_frame_data(data)]))
+
+    def _dispatch_frame(self, session: _Session, data: dict,
+                        frame_id: int) -> None:
+        """Route one admitted frame to the session's current target.
+        Every frame carries the session-owned id, so delivery dedupe
+        holds across failovers regardless of which pipeline answers."""
+        if session.target is None and self.pipeline is not None:
+            self.pipeline.process_frame_local(
+                data, stream_id=session.stream_id,
+                queue_response=session.queue, frame_id=frame_id)
+        elif session.target:
+            self._send_wire_frame(session.target, session.stream_id,
+                                  frame_id, data)
+        else:
+            _logger.warning("gateway: session %s has no live target; "
+                            "frame %d dropped at the door",
+                            session.session_id, frame_id)
+
+    def _on_wire_response(self, topic: str, payload) -> None:
+        """A wire-bound pipeline answered: route the result onto the
+        owning session's queue (the same path local results take)."""
+        try:
+            command, parameters = parse(payload)
+        except Exception:
+            return
+        if command != "process_frame_response" or len(parameters) < 1:
+            return
+        header = dict(parameters[0] or {})
+        body = dict(parameters[1] or {}) if len(parameters) > 1 else {}
+        stream_id = str(header.get("stream_id", ""))
+        okay = str(header.get("okay", "true")).lower() != "false"
+        frame_id = parse_number(header.get("frame_id"), None)
+        from ..pipeline.codec import decode_frame_data
+        try:
+            decoded = decode_frame_data(body)
+        except Exception as error:
+            decoded, okay = {}, False
+            header.setdefault("diagnostic",
+                              f"undecodable result ({error})")
+        entry = (stream_id,
+                 None if frame_id is None else int(frame_id),
+                 decoded, {}, okay,
+                 str(header.get("diagnostic", "")))
+        if stream_id.startswith("gw/"):
+            with self._sessions_lock:
+                session = self.sessions.get(stream_id[3:])
+            if session is not None:
+                session.queue.put(entry)
+        elif stream_id in self._http_waits:
+            waiter = self._http_waits.get(stream_id)
+            if waiter is not None:
+                waiter.put(entry)
+
+    # -- idle-session reaping ----------------------------------------------
+
+    def _reap_loop(self) -> None:
+        idle_s = self.session_idle_ms / 1000.0
+        interval = max(0.02, idle_s / 4.0)
+        while not self._stopped:
+            time.sleep(interval)
+            now = time.monotonic()
+            for session in list(self.sessions.values()):
+                idle = now - session.last_activity
+                if idle >= idle_s:
+                    self._reap_session(session, idle)
+                elif idle >= idle_s / 2.0:
+                    # Half the window gone quiet: ping.  A live client
+                    # pongs (the shared codec answers in recv) and the
+                    # on_frame stamp resets the clock; a vanished one
+                    # stays silent into the reap above.
+                    self._ws_ping(session)
+
+    def _reap_session(self, session: _Session, idle: float) -> None:
+        self.sessions_reaped += 1
+        _logger.warning(
+            "gateway: reaping session %s (idle %.0f ms >= "
+            "session_idle_ms %.0f): stream, window slots and QoS "
+            "budget freed", session.session_id, idle * 1000.0,
+            self.session_idle_ms)
+        telemetry = getattr(self.pipeline, "telemetry", None)
+        if telemetry is not None:
+            telemetry.registry.count("gateway_sessions_reaped")
+        self._destroy_session(session)
+        self._close_conn(session)
+
+    @staticmethod
+    def _ws_ping(session: _Session) -> None:
+        with session.send_lock:
+            conn = session.conn
+            if conn is None:
+                return
+            try:
+                ws.send_frame(conn, b"", ws.OP_PING)
+            except OSError:
+                session.conn = None
 
     @property
     def qos(self) -> QosScheduler:
@@ -237,6 +578,12 @@ class GatewayServer:
 
     def stop(self) -> None:
         self._stopped = True
+        if self._discovery is not None:
+            self._discovery.terminate()
+            self._discovery = None
+        if self.runtime is not None and self._response_topic:
+            self.runtime.remove_message_handler(self._on_wire_response,
+                                                self._response_topic)
         try:
             # shutdown BEFORE close: close() alone does not wake a
             # thread blocked in accept(), and the kernel socket kept
@@ -321,14 +668,21 @@ class GatewayServer:
     def _serve_http(self, conn, method: str, path: str, headers: dict,
                     body_start: bytes) -> None:
         if method == "GET" and path == "/healthz":
+            with self._peers_lock:
+                peers = len(self._peers)
             self._http_reply(conn, 200, {
                 "ok": True, "sessions": self.session_count(),
-                "streams": len(self.pipeline.streams)})
+                "streams": None if self.pipeline is None
+                else len(self.pipeline.streams),
+                "peers": peers})
             return
         if method == "GET" and path == "/stats":
             self._http_reply(conn, 200, {
                 "sessions": self.session_count(),
-                "qos": self.pipeline.qos_stats()})
+                "qos": {} if self.pipeline is None
+                else self.pipeline.qos_stats(),
+                "failovers": self.failovers,
+                "sessions_reaped": self.sessions_reaped})
             return
         if method == "POST" and path == "/v1/frames":
             length = int(headers.get("content-length", "0"))
@@ -372,20 +726,34 @@ class GatewayServer:
             return
         with self._sessions_lock:
             self._http_seq += 1
-            stream_id = f"gwhttp/{self._http_seq}"
+            stream_id = f"gwhttp/{self.port}/{self._http_seq}"
         import queue as queue_module
         responses = queue_module.Queue()
-        parameters = {"tenant": tenant, "qos_class": qos_class}
+        # One-shot streams opt out of the journal: there is no session
+        # to adopt, and replaying them to a 504'd-and-gone client
+        # would be wasted work on the survivor.
+        parameters = {"tenant": tenant, "qos_class": qos_class,
+                      "journal": "off"}
         deadline_ms = request.get("deadline_ms")
         if deadline_ms is not None:
             parameters["frame_deadline_ms"] = float(deadline_ms)
         pipeline = self.pipeline
-        # Mailbox FIFO: the create lands before the ingest, so the
-        # frame sees the session's tenant/class/deadline parameters.
-        pipeline.post_self("create_stream_local",
-                           [stream_id, parameters, None, 0, responses])
-        pipeline.process_frame_local(data, stream_id=stream_id,
-                                     queue_response=responses)
+        target = self._pick_target()
+        if target == "":
+            self._http_reply(conn, 503, {"error": "no backend"})
+            return
+        if target is None:
+            # Mailbox FIFO: the create lands before the ingest, so the
+            # frame sees the session's tenant/class/deadline parameters.
+            pipeline.post_self("create_stream_local",
+                               [stream_id, parameters, None, 0,
+                                responses])
+            pipeline.process_frame_local(data, stream_id=stream_id,
+                                         queue_response=responses)
+        else:
+            self._http_waits[stream_id] = responses
+            self._create_wire_stream(target, stream_id, parameters)
+            self._send_wire_frame(target, stream_id, 0, data)
         try:
             (_, frame_id, swag, metrics, okay, diagnostic) = \
                 responses.get(timeout=_HTTP_TIMEOUT_S)
@@ -393,10 +761,17 @@ class GatewayServer:
             self._http_reply(conn, 504, {"error": "timed out"})
             return
         finally:
-            pipeline.post_self("destroy_stream", [stream_id, True])
+            self._http_waits.pop(stream_id, None)
+            if target is None:
+                pipeline.post_self("destroy_stream", [stream_id, True])
+            else:
+                self.runtime.message.publish(
+                    f"{target}/in",
+                    generate("destroy_stream", [stream_id, True]))
         bare = {key: value for key, value in swag.items()
                 if "." not in key}
-        bare = pipeline.transfer_ledger.fetch(bare)
+        if pipeline is not None:
+            bare = pipeline.transfer_ledger.fetch(bare)
         status = 200 if okay else 503
         self._http_reply(conn, status, {
             "ok": bool(okay), "frame": frame_id,
@@ -471,9 +846,19 @@ class GatewayServer:
 
     def _serve_ws(self, conn: socket.socket) -> None:
         session: _Session | None = None
+        holder: dict = {"session": None}
+
+        def on_frame(_opcode):
+            # Liveness for the idle reaper: ANY wire frame from the
+            # client -- data or the pong answering our ping.
+            live = holder["session"]
+            if live is not None:
+                live.touch()
+
         try:
             while True:
-                opcode, payload = ws.recv_message(conn)
+                opcode, payload = ws.recv_message(conn,
+                                                  on_frame=on_frame)
                 try:
                     message = json.loads(payload.decode())
                 except json.JSONDecodeError as error:
@@ -486,6 +871,8 @@ class GatewayServer:
                     opened = self._ws_open(conn, message)
                     if opened is not None:
                         session = opened
+                        holder["session"] = session
+                        session.touch()
                 elif op == "frame":
                     self._ws_frame(conn, session, message)
                 elif op == "close":
@@ -532,15 +919,28 @@ class GatewayServer:
             # Takeover: results follow the new connection.
             with session.send_lock:
                 session.conn = conn
+            session.touch()
         else:
+            target = self._pick_target()
+            if target == "":
+                with self._sessions_lock:
+                    self.sessions.pop(session_id, None)
+                self._ws_send_raw(conn, {"op": "error",
+                                         "error": "no backend"})
+                return None
             session.conn = conn
+            session.target = target
             parameters = {"tenant": tenant, "qos_class": qos_class}
             if deadline_ms:
                 parameters["frame_deadline_ms"] = deadline_ms
-            self.pipeline.post_self(
-                "create_stream_local",
-                [session.stream_id, parameters, None, 0,
-                 session.queue])
+            if target is None:
+                self.pipeline.post_self(
+                    "create_stream_local",
+                    [session.stream_id, parameters, None, 0,
+                     session.queue])
+            else:
+                self._create_wire_stream(target, session.stream_id,
+                                         parameters)
             session.pump = threading.Thread(
                 target=self._pump_results, args=(session,),
                 daemon=True, name=f"gateway-pump-{session_id}")
@@ -585,9 +985,7 @@ class GatewayServer:
                 payload["tag"] = tag
             self._ws_send(session, payload)
             return
-        self.pipeline.process_frame_local(
-            data, stream_id=session.stream_id,
-            queue_response=session.queue)
+        self._dispatch_frame(session, data, session.next_frame_id())
 
     def _ws_close(self, conn, session: _Session | None) -> None:
         # Only the session's CURRENT connection may destroy it: a
@@ -601,8 +999,13 @@ class GatewayServer:
         with self._sessions_lock:
             self.sessions.pop(session.session_id, None)
         session.closing = True
-        self.pipeline.post_self("destroy_stream",
-                                [session.stream_id, True])
+        if session.target is None and self.pipeline is not None:
+            self.pipeline.post_self("destroy_stream",
+                                    [session.stream_id, True])
+        elif session.target and self.runtime is not None:
+            self.runtime.message.publish(
+                f"{session.target}/in",
+                generate("destroy_stream", [session.stream_id, True]))
         session.queue.put(None)             # wake + retire the pump
 
     def _pump_results(self, session: _Session) -> None:
@@ -616,14 +1019,29 @@ class GatewayServer:
             if entry is None:
                 return
             (_, frame_id, swag, metrics, okay, diagnostic) = entry
+            try:
+                frame_seq = int(frame_id)
+            except (TypeError, ValueError):
+                frame_seq = None
+            if frame_seq is not None:
+                with session.state_lock:
+                    if frame_seq <= session.last_delivered:
+                        # Failover dedupe: the dead pipeline answered
+                        # this frame before dying (or the journal's
+                        # done record raced the crash) and the
+                        # adopter replayed it anyway -- the client
+                        # must see each id exactly once, in order.
+                        continue
+                    session.last_delivered = frame_seq
             e2e_s = session.finish_slot()
             bare = {key: value for key, value in swag.items()
                     if "." not in key}
-            try:
-                bare = pipeline.transfer_ledger.fetch(bare)
-            except Exception as error:
-                okay, diagnostic = False, f"result fetch: {error}"
-                bare = {}
+            if pipeline is not None:
+                try:
+                    bare = pipeline.transfer_ledger.fetch(bare)
+                except Exception as error:
+                    okay, diagnostic = False, f"result fetch: {error}"
+                    bare = {}
             telemetry = getattr(pipeline, "telemetry", None)
             if telemetry is not None:
                 telemetry.registry.observe("gateway_e2e_ms",

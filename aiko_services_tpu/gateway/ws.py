@@ -166,14 +166,19 @@ def recv_frame(sock: socket.socket,
 def recv_message(sock: socket.socket,
                  respond_control: bool = True,
                  mask_replies: bool = False,
-                 max_payload: int = MAX_PAYLOAD_DEFAULT) \
-        -> tuple[int, bytes]:
+                 max_payload: int = MAX_PAYLOAD_DEFAULT,
+                 on_frame=None) -> tuple[int, bytes]:
     """The next DATA message (text/binary), reassembling continuation
     frames and answering pings in line.  Raises :class:`WsClosed` on a
-    close frame, EOF, or a frame/message past ``max_payload``."""
+    close frame, EOF, or a frame/message past ``max_payload``.
+    ``on_frame(opcode)`` fires for every wire frame received --
+    control frames included, which is how the gateway's idle-session
+    reaper sees a client's pong as liveness."""
     opcode, payload = None, b""
     while True:
         frame_op, fin, chunk = recv_frame(sock, max_payload=max_payload)
+        if on_frame is not None:
+            on_frame(frame_op)
         if frame_op == OP_CLOSE:
             if respond_control:
                 try:

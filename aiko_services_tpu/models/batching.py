@@ -975,6 +975,80 @@ class ContinuousBatcher:
         self.recoveries += 1
         return len(revived)
 
+    def resume_request(self, request: Request, committed) -> bool:
+        """Fold an externally journaled committed prefix into a
+        just-submitted request (process-level adoption/migration,
+        ISSUE 13): the same ``_rebase`` discipline page-pool
+        preemption and ``recover()`` use, applied across a process
+        boundary -- prompt + committed re-prefill, generation
+        continues under the remaining budget, nothing already
+        streamed is re-emitted (the caller pre-seeds its collector
+        with the committed tokens instead).
+
+        Returns False when the prefix already FINISHED the request
+        (its last token is EOS, the budget is spent, or the sequence
+        is at max_seq -- the process died between the final emit and
+        delivery): the request is withdrawn, not resumed -- decoding
+        past a finished prefix would append a spurious tail to text
+        the contract promises byte-identical.  The caller completes
+        from the committed tokens it already holds."""
+        request.committed = [int(token) for token in committed]
+        request.generated = len(request.committed)
+        if request.generated:
+            # ttft/tpot stamps would span the failover, not serving:
+            # a resumed request reports no latency stats.
+            request.submit_time = 0.0
+        self._rebase(request)
+        finished = bool(request.committed) and (
+            request.committed[-1] in request.eos_tokens
+            or request.generated >= request.max_new_tokens
+            or len(request.prompt_tokens) >= self.max_seq)
+        if finished:
+            request.done = True
+            if request in self.pending:
+                self.pending.remove(request)
+        return not finished
+
+    def export_state(self) -> list[dict]:
+        """Committed state of every live (not finished) request --
+        the drain/migration handoff record.  Each entry is enough for
+        :meth:`import_state` on a peer to resume the request at its
+        committed prefix."""
+        entries = []
+        live = [request for request in self.slots
+                if request is not None] + list(self.pending)
+        for request in live:
+            if request.done:
+                continue
+            entries.append({
+                "request_id": request.request_id,
+                "prompt": [int(t) for t in request.base_prompt],
+                "committed": [int(t) for t in request.committed],
+                "max_new_tokens": int(request.max_new_tokens),
+                "temperature": float(request.temperature),
+                "eos_tokens": [int(t) for t in request.eos_tokens]})
+        return entries
+
+    def import_state(self, entries, emit_factory=None) -> int:
+        """Resume exported requests at their committed prefix.
+        ``emit_factory(entry) -> emit`` wires each request's token
+        callback (None = no emission).  Returns how many were
+        queued."""
+        count = 0
+        for entry in entries:
+            request = Request(
+                request_id=str(entry["request_id"]),
+                prompt_tokens=list(entry["prompt"]),
+                max_new_tokens=int(entry.get("max_new_tokens", 128)),
+                temperature=float(entry.get("temperature", 0.0)),
+                eos_tokens=tuple(entry.get("eos_tokens", ())))
+            if emit_factory is not None:
+                request.emit = emit_factory(entry)
+            self.submit(request)
+            self.resume_request(request, entry.get("committed", ()))
+            count += 1
+        return count
+
     def take_request_stats(self) -> list[dict]:
         """Drain per-request latency stamps ({"ttft_ms", "tpot_ms",
         "tokens"}) recorded at finish -- the serving element feeds them

@@ -9,6 +9,8 @@ from .pipeline import Pipeline, RemoteStage, PROTOCOL_PIPELINE, \
 from .scheme import DataScheme, DataSource, DataTarget, contains_all
 from .codec import (encode_frame_data, decode_frame_data, encode_value,
                     decode_value)
+from .journal import (StreamJournal, JournalState, load_journal,
+                      claim_adoption, adopter_of)
 from .overlap import TransferLedger, DeviceWindow, device_leaves
 from .fusion import (DeviceFn, FusedSegment, FusionError, FUSE_MODES,
                      setup_compilation_cache)

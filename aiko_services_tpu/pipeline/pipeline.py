@@ -23,6 +23,7 @@ Differences from the reference, by design:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any
@@ -37,6 +38,9 @@ from .definition import (PipelineDefinition, parse_pipeline_definition,
 from .element import ElementContext, PipelineElement, PipelineElementLoop
 from .fusion import (FUSE_MODES, FusedSegment, partition,
                      setup_compilation_cache)
+from .journal import (ADOPT_LIMIT_DEFAULT, DRAIN_TIMEOUT_MS_DEFAULT,
+                      JOURNAL_FSYNC_MS_DEFAULT, StreamJournal,
+                      claim_adoption, decode_payload, load_journal)
 from .overlap import (DEVICE_INFLIGHT_DEFAULT, TransferLedger,
                       touches_devices)
 from .stages import (STAGE_INFLIGHT_DEFAULT, STAGE_PIPELINE_MODES,
@@ -108,6 +112,10 @@ REPLICA_SCALE_DOWN_OCCUPANCY = 0.25
 # failure episode (every frame missing its deadline) writes one dump
 # per window, not one per frame on the event loop.
 _BLACKBOX_COOLDOWN_S = 5.0
+# Drained pipelines keep accepting (journal + hold) this long after
+# announcing death, so frames in flight toward them land in the
+# journal before the adopter's settle-delayed read -- then stop.
+_DRAIN_RETIRE_GRACE_S = 1.0
 
 # Stage-worker threads (pipeline/stages.py) run elements off the event
 # loop; ``get_parameter`` resolution reaches the owning stream through
@@ -228,9 +236,60 @@ class Pipeline(Actor):
                 host=str(definition.parameters.get(
                     "gateway_host", "127.0.0.1")),
                 port=int(parse_number(
-                    definition.parameters.get("gateway_port"), 0)))
+                    definition.parameters.get("gateway_port"), 0)),
+                session_idle_ms=float(parse_number(
+                    definition.parameters.get("session_idle_ms"),
+                    0.0)))
             tags.append(f"gateway={self.gateway.host}:"
                         f"{self.gateway.port}")
+        # Durable stream journal + process fault domain (ISSUE 13):
+        # ``journal: on`` appends each stream's recoverable state
+        # (parameters, per-frame ingest payloads, delivery commits,
+        # LLM committed token prefixes) to an fsync-batched journal
+        # under ``journal_dir``, so a peer can ADOPT this pipeline's
+        # live streams after an unclean process death -- and ``drain``
+        # makes the same handoff cooperative (zero frame drop) for
+        # rolling restarts.  Validated BEFORE actor registration: dead
+        # config fails at create, not at the process death it was
+        # configured to survive.
+        self.journal: StreamJournal | None = None
+        self._journal_resume: dict[tuple, list] = {}
+        self._journal_lag_noted = 0.0
+        self._draining = False
+        self._drained = False
+        self._drain_deadline = 0.0
+        self._streams_adopted = 0
+        self._frames_journal_replayed = 0
+        self._adopt_limit = int(parse_number(
+            definition.parameters.get("adopt_limit"),
+            ADOPT_LIMIT_DEFAULT))
+        self._drain_timeout_ms = float(parse_number(
+            definition.parameters.get("drain_timeout_ms"),
+            DRAIN_TIMEOUT_MS_DEFAULT))
+        journal_mode = str(definition.parameters.get(
+            "journal", "off")).strip().lower()
+        self._journal_dir = definition.parameters.get("journal_dir")
+        self._journal_dir = str(self._journal_dir) \
+            if self._journal_dir else None
+        if journal_mode in ("on", "true", "1"):
+            if not self._journal_dir:
+                self._construction_failed()
+                raise DefinitionError(
+                    f"pipeline {definition.name!r}: journal: on needs "
+                    f"a writable journal_dir")
+            try:
+                os.makedirs(self._journal_dir, exist_ok=True)
+                self.journal = StreamJournal(
+                    os.path.join(self._journal_dir,
+                                 f"{name or definition.name}.journal"),
+                    fsync_ms=float(parse_number(
+                        definition.parameters.get("journal_fsync_ms"),
+                        JOURNAL_FSYNC_MS_DEFAULT)))
+            except OSError as error:
+                self._construction_failed()
+                raise DefinitionError(
+                    f"pipeline {definition.name!r}: journal_dir="
+                    f"{self._journal_dir!r} is not writable ({error})")
         self._pipe_senders: dict[str, PipeSender] = {}
         self._pipe_token_seq = 0
         self._pipe_fallback_logged: set = set()
@@ -253,8 +312,15 @@ class Pipeline(Actor):
             if preflight_report is not None:
                 for finding in preflight_report.findings:
                     self.logger.warning("pre-flight: %s", finding.render())
+            if self.gateway is not None:
+                # Failover plane (ISSUE 13): the gateway joins the
+                # fabric AFTER actor registration -- it needs the
+                # runtime for peer discovery and its wire-response
+                # topic, neither of which exists when its socket binds.
+                self.gateway.attach_runtime(self.runtime)
             self.streams: dict[str, Stream] = {}
             self._current_stream_ref: Stream | None = None
+            self._current_frame_ref: Frame | None = None
             self._pipeline_parameters = dict(definition.parameters)
             # Device-resident swag accounting (pipeline/overlap.py): the
             # ``transfer_guard`` parameter sets the policy for every
@@ -386,6 +452,10 @@ class Pipeline(Actor):
             self._blackbox_dumps = 0
             self._blackbox_last: dict[str, float] = {}
 
+            self.share["streams_adopted"] = 0
+            self.share["frames_journal_replayed"] = 0
+            self.share["drained"] = False
+
             if self.gateway is not None:
                 self.share["gateway_port"] = self.gateway.port
 
@@ -417,9 +487,24 @@ class Pipeline(Actor):
                 # endpoint binds before registration too.
                 self._data_endpoint.close()
                 self._data_endpoint = None
+            journal = getattr(self, "journal", None)
+            if journal is not None:
+                journal.close()
             raise
 
     # -- graph construction ------------------------------------------------
+
+    def _construction_failed(self) -> None:
+        """Release the pre-registration binds (gateway socket, tensor
+        pipe) when ``__init__`` aborts BEFORE its guarded try block --
+        a create-time DefinitionError must not leak an accepting
+        socket."""
+        if self.gateway is not None:
+            self.gateway.stop()
+            self.gateway = None
+        if self._data_endpoint is not None:
+            self._data_endpoint.close()
+            self._data_endpoint = None
 
     def _build_placement(self):
         """Collect per-element ``placement`` blocks from the definition
@@ -1789,6 +1874,11 @@ class Pipeline(Actor):
                            [stream_id, frame_id, frame],
                            delay=remaining + 0.005)
             return
+        if self._draining:
+            # Deadline errors are deliveries; a draining pipeline
+            # parks the frame for adoption instead (see
+            # ``_past_deadline``).
+            return
         self._deadline_fail(stream, frame)
 
     def _shed_for_overload(self, stream: Stream) -> bool:
@@ -1957,6 +2047,12 @@ class Pipeline(Actor):
                        delay=stream.deadline_ms / 1000.0 + 0.002)
 
     def _past_deadline(self, frame: Frame) -> bool:
+        if self._draining:
+            # A drain window suspends SLO enforcement: a deadline
+            # error is a DELIVERY, and everything delivered here
+            # would be excluded from the adopter's replay -- the
+            # zero-drop handoff beats a late-frame error.
+            return False
         return frame.deadline is not None \
             and time.monotonic() > frame.deadline
 
@@ -2301,6 +2397,17 @@ class Pipeline(Actor):
             stream.qos_class = resolved
         elif requested_class is not None:
             stream.qos_class = str(requested_class)
+        # Durable journal (ISSUE 13): resolved once per stream; a
+        # stream-level ``journal: off`` opts out (one-shot HTTP
+        # streams, sub-streams nothing will ever adopt).
+        if self.journal is not None:
+            stream.journal = str(stream.parameters.get(
+                "journal", "on")).strip().lower() \
+                not in ("off", "false", "0")
+        if self.journal is not None and stream.journal:
+            self.journal.stream_open(stream_id, stream.parameters,
+                                     graph_path=graph_path,
+                                     topic_response=topic_response)
         if grace_time:
             stream.lease = Lease(
                 self.runtime.engine, float(grace_time), stream_id,
@@ -2436,7 +2543,287 @@ class Pipeline(Actor):
         # dead incarnation's same-id frames (recorder.frame_events
         # splits at this marker -- the ring itself is append-only).
         self._rec("stream_end", stream_id)
+        if self.journal is not None and stream.journal \
+                and not self._draining:
+            # Graceful destroy leaves nothing to adopt.  A DRAINING
+            # pipeline's streams stay OPEN in the journal: their
+            # undelivered frames are the handoff.
+            self.journal.stream_close(stream_id)
         self.ec_producer.update("streams", len(self.streams))
+
+    # -- process-level fault domain (ISSUE 13) -----------------------------
+
+    def kill(self):
+        """Simulate unclean process death for THIS pipeline service
+        (the in-process twin of SIGKILL, for chaos tests and the
+        ``process_kill`` fault point): publish the retained
+        ``(absent)`` the per-service LWT would have sent (the
+        registrar reaps the service, peers' discovery fires), stop
+        serving every topic and mailbox, and drop all streams with NO
+        responses.  The journal is left exactly as the crash left it
+        -- that is the artifact a peer adopts."""
+        if getattr(self, "_killed", False):
+            return
+        self._killed = True
+        self.logger.warning("pipeline %s: unclean death (kill)",
+                            self.name)
+        try:
+            self.publish_state("(absent)")
+        except Exception:
+            pass
+        engine = self.runtime.engine
+        engine.remove_mailbox_handler(self._mailbox_control)
+        engine.remove_mailbox_handler(self._mailbox_in)
+        self.runtime.remove_message_handler(self._topic_control_handler,
+                                            self.topic_control)
+        self.runtime.remove_message_handler(self._topic_in_handler,
+                                            self.topic_in)
+        self._cancel_health_timer()     # autoscale timer included
+        if self.gateway is not None:
+            self.gateway.stop()
+            self.gateway = None
+        if self._data_endpoint is not None:
+            self._data_endpoint.close()
+            self._data_endpoint = None
+        for stream in list(self.streams.values()):
+            if stream.lease is not None:
+                stream.lease.terminate()
+            for handle in stream.generator_handles:
+                handle.set()
+            stream.device_window.clear()
+        self.streams.clear()
+        if self.stage_scheduler is not None:
+            self.stage_scheduler.stop()
+
+    def adopt(self, source=None, response_topic=None,
+              adopt_limit=None):
+        """Wire/local command: ``(adopt <pipeline-or-journal-path>
+        [response_topic])`` -- reconstruct a dead peer's live streams
+        from its journal and replay every undelivered frame, in
+        order, deduped by the delivered-set (nothing the peer already
+        answered is re-sent).  LLM streams resume at their journaled
+        committed token prefix.  Exactly one adopter wins the
+        journal's claim file; a stream id that already exists locally
+        is refused individually.  Bounded by ``adopt_limit`` the way
+        replay is by ``replay_limit``.  Returns the number of streams
+        adopted."""
+        if self.journal is None and not self._journal_dir:
+            self.logger.error("adopt: no journal_dir configured")
+            return 0
+        if self._draining:
+            self.logger.warning("adopt: refusing while draining")
+            return 0
+        source = str(source or "")
+        if source.endswith(".journal") or os.sep in source:
+            path = source
+        else:
+            path = os.path.join(self._journal_dir,
+                                f"{source}.journal")
+        name = os.path.basename(path).rsplit(".journal", 1)[0]
+        if self.journal is not None \
+                and os.path.abspath(path) == \
+                os.path.abspath(self.journal.path):
+            self.logger.error("adopt: refusing to adopt my own journal")
+            return 0
+        if not os.path.exists(path):
+            self.logger.warning("adopt: journal %s does not exist",
+                                path)
+            return 0
+        # Read BEFORE claiming: a journal with nothing live to adopt
+        # (typically the dead pipeline's supervisor respawned it
+        # first, truncating to a fresh incarnation and orphaning the
+        # crash state) must not be claimed -- a stale claim on a LIVE
+        # pipeline's journal would fence its NEXT death's adoption.
+        state = load_journal(path)
+        if not state.live_streams():
+            self.logger.warning(
+                "adopt: journal %s has no live streams (respawned "
+                "fresh, drained clean, or empty); nothing to adopt",
+                path)
+            return 0
+        if not claim_adoption(path, self.name):
+            # Double adoption would double-replay undelivered frames.
+            self.logger.warning(
+                "adopt: journal %s already claimed; refusing", path)
+            return 0
+        state = load_journal(path)
+        limit = int(parse_number(adopt_limit, self._adopt_limit))
+        adopted = replayed = skipped = 0
+        for entry in state.live_streams():
+            if entry.stream_id in self.streams:
+                self.logger.warning(
+                    "adopt: stream %s already live here; refusing it",
+                    entry.stream_id)
+                continue
+            if adopted >= limit:
+                skipped += 1
+                continue
+            # The stream's OWN journaled response topic wins: a direct
+            # wire client's replayed results must go back to it, not
+            # to the gateway that happened to command the adoption
+            # (whose topic is the fallback for queue-based sessions
+            # that had no topic to journal).
+            topic = entry.topic_response or response_topic
+            stream = self.create_stream_local(
+                entry.stream_id, parameters=dict(entry.parameters),
+                graph_path=entry.graph_path, topic_response=topic)
+            if stream is None:
+                continue
+            adopted += 1
+            stream.frame_count = max(
+                entry.done_upto + 1,
+                (max(entry.frames) + 1) if entry.frames else 0)
+            undelivered = entry.undelivered
+            self._rec("adopt", entry.stream_id, None, name,
+                      info={"frames": len(undelivered)})
+            for frame_id, tokens in sorted(entry.llm.items()):
+                if not tokens:
+                    continue
+                self._journal_resume[(entry.stream_id,
+                                      int(frame_id))] = list(tokens)
+                if self.journal is not None and stream.journal:
+                    # The inherited prefix becomes durable HERE, so a
+                    # second failover resumes from the same place.
+                    self.journal.llm_tokens(entry.stream_id, frame_id,
+                                            tokens)
+            for frame_id in undelivered:
+                record = entry.frames[frame_id]
+                try:
+                    data = decode_payload(record.get("data"))
+                except Exception as error:
+                    self.logger.warning(
+                        "adopt: stream %s frame %s payload "
+                        "undecodable (%s); dropped", entry.stream_id,
+                        frame_id, error)
+                    continue
+                replayed += 1
+                self._ingest({"stream_id": entry.stream_id,
+                              "frame_id": frame_id,
+                              "response_topic": topic}, data)
+        self._streams_adopted += adopted
+        self._frames_journal_replayed += replayed
+        self.share["streams_adopted"] = self._streams_adopted
+        self.share["frames_journal_replayed"] = \
+            self._frames_journal_replayed
+        if self.telemetry is not None and adopted:
+            self.telemetry.registry.count("streams_adopted", adopted)
+            self.telemetry.registry.count("frames_journal_replayed",
+                                          replayed)
+        self.logger.info(
+            "adopted %d stream(s) / %d frame(s) from %s%s", adopted,
+            replayed, name,
+            f" ({skipped} past adopt_limit)" if skipped else "")
+        return adopted
+
+    def drain(self, *_args):
+        """Wire/CLI command: cooperative shutdown with zero frame
+        drop.  Admission stops (frames arriving from now on are
+        journaled and PARKED for the adopter, never run), in-flight
+        LLM requests are migrated at their committed prefix (their
+        tokens are already journaled; the element cancels them and
+        drops the parked frames without responding), in-flight plain
+        frames get ``drain_timeout_ms`` to finish normally, then the
+        journal is marked cleanly drained and the service announces
+        its death -- the same LWT path an unclean kill takes, so the
+        gateway's failover machinery hands the sessions to a peer
+        that adopts the journal.  Rolling restarts are this, per
+        pipeline, in sequence."""
+        if self._draining:
+            return
+        self._draining = True
+        self._rec("drain", None, info={"phase": "start"})
+        self.logger.info("pipeline %s: draining (timeout %.0f ms)",
+                         self.name, self._drain_timeout_ms)
+        for node in self.graph.nodes():
+            drainer = getattr(node.element, "drain_requests", None)
+            if callable(drainer):
+                try:
+                    drainer()
+                except Exception:
+                    self.logger.exception("drain_requests failed for "
+                                          "%s", node.name)
+        self._drain_deadline = time.monotonic() \
+            + self._drain_timeout_ms / 1000.0
+        self.post_self("drain_tick", [])
+
+    def drain_tick(self):
+        """Drain progress check (self-posted): in-flight frames get
+        until the deadline; whatever is still parked then is handed
+        to the adopter through the journal."""
+        if not self._draining or self._drained:
+            return
+        busy = sum(len(stream.frames)
+                   for stream in self.streams.values())
+        if busy and time.monotonic() < self._drain_deadline:
+            self.post_self("drain_tick", [], delay=0.02)
+            return
+        self._drain_finish(busy)
+
+    def _drain_finish(self, leftover: int) -> None:
+        for stream in list(self.streams.values()):
+            for frame in list(stream.frames.values()):
+                # Parked past the deadline: parked for adoption.  No
+                # response -- the adopter's replay is the response.
+                stream.frames.pop(frame.frame_id, None)
+                self._qos_done(frame)
+                self._release_stage(stream, frame)
+        if self.journal is not None:
+            self.journal.mark_drained()
+        self._drained = True
+        self._rec("drain", None, info={"phase": "done",
+                                       "leftover": leftover})
+        self.logger.info("pipeline %s: drained (%d frame(s) parked "
+                         "for adoption)", self.name, leftover)
+        try:
+            self.publish_state("(absent)")
+        except Exception:
+            pass
+        # Retirement GRACE, not immediate stop: until the gateway's
+        # settle window elapses and its sessions re-bind, frames
+        # already in flight toward this pipeline keep arriving -- each
+        # must still ingest (journal + hold, the ``_draining`` path)
+        # so the adopter's journal read includes it.  Retiring the
+        # mailbox inside that window would drop exactly the frames the
+        # zero-drop contract promises to keep.
+        self.runtime.engine.add_oneshot_timer(self._retire_after_drain,
+                                              _DRAIN_RETIRE_GRACE_S)
+
+    def _retire_after_drain(self):
+        # The share marker is the process-exit signal (``pipeline
+        # create`` runs until it): set AFTER the grace, so a
+        # supervisor cannot reap the process while stragglers are
+        # still being journaled.
+        self.share["drained"] = True
+        try:
+            self.ec_producer.update("drained", True)
+        except Exception:
+            pass
+        try:
+            self.stop()
+        except Exception:
+            self.logger.exception("post-drain stop failed")
+
+    def take_journal_resume(self, stream_id, frame_id) -> list | None:
+        """Adopted LLM committed prefix for (stream, frame), consumed
+        exactly once by the serving element."""
+        return self._journal_resume.pop(
+            (str(stream_id), int(frame_id)), None)
+
+    def current_frame(self) -> Frame | None:
+        """The frame whose element dispatch is running on the event
+        loop right now (async submit seam) -- lets an element key
+        per-frame engine state (journal resume) without a signature
+        change."""
+        return self._current_frame_ref
+
+    def failover_stats(self) -> dict:
+        return {
+            "journal": None if self.journal is None
+            else self.journal.stats(),
+            "draining": self._draining, "drained": self._drained,
+            "streams_adopted": self._streams_adopted,
+            "frames_journal_replayed": self._frames_journal_replayed,
+            "resume_pending": len(self._journal_resume)}
 
     # -- frame ingestion ---------------------------------------------------
 
@@ -2461,13 +2848,19 @@ class Pipeline(Actor):
 
     def process_frame_local(self, frame_data: dict,
                             stream_id=DEFAULT_STREAM_ID,
-                            queue_response=None) -> None:
+                            queue_response=None,
+                            frame_id=None) -> None:
         """In-process API: no encoding, swag values pass by reference.
-        Thread-safe (hops through the actor mailbox)."""
+        Thread-safe (hops through the actor mailbox).  An explicit
+        ``frame_id`` lets a session-owning caller (the gateway) keep
+        one frame-id space across pipeline failovers, so delivery
+        dedupe works no matter which peer answers."""
         self.post_self("ingest_local",
-                       [str(stream_id), frame_data, queue_response])
+                       [str(stream_id), frame_data, queue_response,
+                        frame_id])
 
-    def ingest_local(self, stream_id, frame_data, queue_response=None):
+    def ingest_local(self, stream_id, frame_data, queue_response=None,
+                     frame_id=None):
         stream = self.streams.get(str(stream_id))
         if stream is None:
             stream = self.create_stream_local(stream_id,
@@ -2476,8 +2869,12 @@ class Pipeline(Actor):
                 return
         elif queue_response is not None:
             stream.queue_response = queue_response
-        frame = Frame(frame_id=stream.next_frame_id(),
-                      swag=dict(frame_data))
+        if frame_id is None:
+            frame_id = stream.next_frame_id()
+        else:
+            frame_id = int(frame_id)
+            stream.frame_count = max(stream.frame_count, frame_id + 1)
+        frame = Frame(frame_id=frame_id, swag=dict(frame_data))
         if self.telemetry is not None:
             self.telemetry.frame_started(frame)
         self._rec("ingest", stream.stream_id, frame.frame_id)
@@ -2486,6 +2883,13 @@ class Pipeline(Actor):
             or self._qos_shed_for_overload(stream, frame)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
+        self._journal_ingest(stream, frame)
+        if self._draining:
+            self._hold_for_drain(stream, frame)
+            return
+        if self._faults is not None \
+                and self._process_fault_probe(stream, frame):
+            return
         if shed:
             self._shed_incoming(stream, frame)
             return
@@ -2535,6 +2939,13 @@ class Pipeline(Actor):
             or self._qos_shed_for_overload(stream, frame)
         self._assign_delivery_seq(stream, frame)
         stream.frames[frame.frame_id] = frame
+        self._journal_ingest(stream, frame)
+        if self._draining:
+            self._hold_for_drain(stream, frame)
+            return
+        if self._faults is not None \
+                and self._process_fault_probe(stream, frame):
+            return
         if shed:
             self._shed_incoming(stream, frame)
             return
@@ -2543,6 +2954,64 @@ class Pipeline(Actor):
         if paced:
             self._note_pace(stream, frame, paced)
         self._process_frame_common(stream, frame)
+
+    # -- process fault domain (ISSUE 13) -----------------------------------
+
+    def _journal_ingest(self, stream: Stream, frame: Frame) -> None:
+        """Journal commit point: the frame's host-visible inputs, so a
+        peer can replay it if this process dies before delivery."""
+        if self.journal is None or not stream.journal:
+            return
+        lag = self.journal.frame_ingested(stream.stream_id,
+                                          frame.frame_id, frame.swag)
+        if lag >= 256:
+            # The fsync backlog grew a whole batch window deep --
+            # frames in it are past the durability horizon if the host
+            # (not just the process) dies.  Ring-logged, throttled.
+            now = time.monotonic()
+            if now - self._journal_lag_noted > 1.0:
+                self._journal_lag_noted = now
+                self._rec("journal_lag", stream.stream_id,
+                          frame.frame_id, info={"pending": lag})
+
+    def _hold_for_drain(self, stream: Stream, frame: Frame) -> None:
+        """A frame ingested while draining is journaled but never run:
+        it is parked for the adopter, which replays it -- zero drop,
+        no duplicate (nothing was delivered from here).  A frame with
+        NO journal behind it (journal off, or a journal-off stream
+        like the gateway's one-shots) has no adopter to park for:
+        failing it loudly beats swallowing it into a client timeout."""
+        if self.journal is None or not stream.journal:
+            self._frame_fail(stream, frame,
+                             "draining: no journal to hand off")
+            return
+        stream.frames.pop(frame.frame_id, None)
+        self._qos_done(frame)
+        # Consume the delivery slot silently so any in-flight
+        # predecessors still flush their real responses in order.
+        self._deliver(stream, frame, okay=False, skip=True)
+
+    def _process_fault_probe(self, stream: Stream,
+                             frame: Frame) -> bool:
+        """Armed-chaos seam for the process-level fault points
+        (tier-1's in-process realization; the multi-process driver
+        uses real signals).  Returns True when the frame must not be
+        processed (the process "died" -- the journaled frame replays
+        on the adopter)."""
+        rule = self._faults.should("process_kill", target=self.name,
+                                   stream=stream.stream_id)
+        if rule is not None:
+            self.logger.warning("chaos: process_kill fired at %s; "
+                                "dying uncleanly", self.name)
+            self.kill()
+            return True
+        rule = self._faults.should("process_hang", target=self.name,
+                                   stream=stream.stream_id)
+        if rule is not None and rule.delay_ms:
+            # The whole event loop stalls: parked frames age, peers'
+            # deadlines fire -- exactly what a wedged process does.
+            time.sleep(rule.delay_ms / 1000.0)
+        return False
 
     def _note_pace(self, stream: Stream, frame: Frame,
                    paced: float) -> None:
@@ -3521,7 +3990,8 @@ class Pipeline(Actor):
                             time.perf_counter() - start, frame, epoch])
 
         ledger = self.transfer_ledger
-        try:
+        self._current_frame_ref = frame     # current_frame() for the
+        try:                                # submit's element code
             if self._faults is not None:
                 self._inject_element_fault(node_name, stream_id)
             if node.element.device_resident and ledger.active:
@@ -3552,6 +4022,8 @@ class Pipeline(Actor):
             if self._recover_after_dispatch_error(stream, frame):
                 return          # chips died: frame replayed/bounded
             self._frame_error(stream, frame, f"{node_name}: {error}")
+        finally:
+            self._current_frame_ref = None
 
     def resume_frame_local(self, stream_id, frame_id, node_name,
                            event, outputs, elapsed, frame_ref=None,
@@ -3819,8 +4291,18 @@ class Pipeline(Actor):
             # must be on frame.spans when _respond encodes them back
             # to a forwarding origin.
             self.telemetry.frame_finished(stream, frame, okay=True)
-        self._deliver(stream, frame, okay=True,
-                      skip=bool(frame.metrics.get("dropped")))
+        dropped = bool(frame.metrics.get("dropped"))
+        if dropped and not self._draining \
+                and self.journal is not None and stream.journal:
+            # A dropped frame is CONSUMED: prune it, or it stays
+            # 'undelivered' forever -- wedging the done_upto
+            # watermark, growing the journal unboundedly, and
+            # replaying every historically dropped frame on adoption.
+            # EXCEPT while draining: the LLM drain migration drops
+            # its parked frames precisely so the adopter replays them.
+            self.journal.frame_done(stream.stream_id, frame.frame_id,
+                                    ok=True)
+        self._deliver(stream, frame, okay=True, skip=dropped)
         if stream.state == StreamState.STOP:
             self.post_self("destroy_stream", [stream.stream_id, True])
 
@@ -3951,6 +4433,14 @@ class Pipeline(Actor):
                 (stream.stream_id, frame.frame_id,
                  dict(frame.swag), dict(frame.metrics), okay,
                  diagnostic))
+        if self.journal is not None and stream.journal:
+            # Delivery is the journal's prune point -- appended AFTER
+            # the send, deliberately: a crash between the two turns
+            # into a duplicate replay the gateway's seq dedupe drops,
+            # where the reverse order would be a silent loss (marked
+            # delivered, never sent, excluded from replay).
+            self.journal.frame_done(stream.stream_id, frame.frame_id,
+                                    ok=okay)
 
     # -- remote stage park / forward / resume ------------------------------
 
@@ -4187,6 +4677,8 @@ class Pipeline(Actor):
             self._data_endpoint = None
         for sender in self._pipe_senders.values():
             sender.close()
+        if self.journal is not None:
+            self.journal.close()
         super().stop()
 
 

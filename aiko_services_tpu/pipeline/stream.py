@@ -203,6 +203,12 @@ class Stream:
     # never within one.
     tenant: str = "default"
     qos_class: str = "standard"
+    # Durable stream journal (ISSUE 13): whether this stream's
+    # recoverable state is journaled (resolved once at creation from
+    # the pipeline's ``journal`` parameter; a stream-level
+    # ``journal: off`` opts out -- e.g. the gateway's one-shot HTTP
+    # streams, which have no session to adopt).
+    journal: bool = False
 
     def next_frame_id(self) -> int:
         frame_id = self.frame_count

@@ -2473,6 +2473,254 @@ def bench_pipeline_gateway() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Process-level fault domain (ISSUE 13): journal overhead, kill ->
+# first-frame-on-peer MTTR, and a rolling restart under the loadgen.
+
+FAILOVER_BUSY_MS = 4.0
+FAILOVER_JOURNAL_FRAMES = 120
+FAILOVER_OVERHEAD_GATE_PCT = 2.0
+
+
+def bench_pipeline_failover() -> dict:
+    import queue
+    import threading
+    import time as time_module
+
+    import jax
+    import numpy as np
+
+    if len(jax.devices()) < 2:
+        return {"pipeline_failover_skipped":
+                f"needs >= 2 devices, have {len(jax.devices())}"}
+    import tempfile
+
+    from aiko_services_tpu.gateway.client import GatewayClient
+    from aiko_services_tpu.gateway.loadgen import LoadSpec, run_loadgen
+    from aiko_services_tpu.gateway.server import GatewayServer
+    from aiko_services_tpu.pipeline import Pipeline
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.services import Registrar
+    from aiko_services_tpu.services.share import reset_services_cache
+    from aiko_services_tpu.transport import reset_broker
+
+    workdir = tempfile.mkdtemp(prefix="aiko_bench_failover_")
+    payload = {"x": np.ones((64,), np.float32)}
+
+    def make_pipeline(runtime, name, journal, busy_ms,
+                      drain_timeout_ms=2000):
+        parameters = {"drain_timeout_ms": drain_timeout_ms}
+        if journal:
+            parameters.update({"journal": "on",
+                               "journal_dir": workdir})
+        return Pipeline(
+            {"version": 0, "name": name, "runtime": "jax",
+             "graph": ["(work finish)"],
+             "parameters": parameters,
+             "elements": [
+                 {**element("work", "StageWork", ["x"], ["x"],
+                            {"busy_ms": busy_ms, "factor": 2.0}),
+                  "placement": {"devices": 2}},
+                 {**element("finish", "StageWork", ["x"], ["x"],
+                            {"busy_ms": busy_ms, "factor": 3.0}),
+                  "placement": {"devices": 2}},
+             ]}, runtime=runtime)
+
+    def fresh_runtime():
+        reset_broker()
+        reset_services_cache()
+        reset_process()
+        runtime = init_process(transport="loopback")
+        runtime.initialize()
+        return runtime
+
+    result: dict = {}
+
+    # -- journal overhead A/B: same workload, journal on vs off ----------
+    def measure_fps(journal: bool) -> float:
+        runtime = fresh_runtime()
+        try:
+            pipeline = make_pipeline(runtime, "jmeas", journal,
+                                     FAILOVER_BUSY_MS)
+            for stream_id, frames in (("warm", 16),
+                                      ("meas", FAILOVER_JOURNAL_FRAMES)):
+                responses = queue.Queue()
+                pipeline.create_stream_local(
+                    stream_id, queue_response=responses)
+                start = time_module.perf_counter()
+                for _ in range(frames):
+                    pipeline.process_frame_local(dict(payload),
+                                                 stream_id=stream_id)
+                runtime.run(until=lambda: responses.qsize() == frames,
+                            timeout=120.0)
+                elapsed = time_module.perf_counter() - start
+                if responses.qsize() != frames:
+                    raise RuntimeError(
+                        f"journal fps pass hung at "
+                        f"{responses.qsize()}/{frames}")
+            return frames / elapsed
+        finally:
+            runtime.terminate()
+
+    # Scheduler jitter can exceed the 2% gate on a loaded CPU host:
+    # re-measure up to 3x (the recorder-overhead discipline) -- a
+    # genuine >2% journal cost fails all attempts.
+    for _attempt in range(3):
+        fps_off = measure_fps(journal=False)
+        fps_on = measure_fps(journal=True)
+        overhead_pct = (fps_off - fps_on) / fps_off * 100.0
+        if overhead_pct <= FAILOVER_OVERHEAD_GATE_PCT:
+            break
+    result.update({
+        "pipeline_nojournal_fps": round(fps_off, 2),
+        "pipeline_journal_fps": round(fps_on, 2),
+        "journal_overhead_pct": round(overhead_pct, 2),
+        "journal_overhead_within_gate":
+            bool(overhead_pct <= FAILOVER_OVERHEAD_GATE_PCT),
+    })
+
+    # -- kill -> first-frame-on-peer MTTR under load ---------------------
+    runtime = fresh_runtime()
+    try:
+        Registrar(runtime=runtime, primary_search_timeout=0.05)
+        p1 = make_pipeline(runtime, "fsrv1", True, 25.0)
+        gateway = GatewayServer(runtime=runtime)
+        runtime.run(until=lambda: len(gateway._peers) == 1,
+                    timeout=10.0)
+        p2 = make_pipeline(runtime, "fsrv2", True, 25.0)
+        runtime.run(until=lambda: len(gateway._peers) == 2,
+                    timeout=10.0)
+        client = GatewayClient("127.0.0.1", gateway.port,
+                               timeout=120.0)
+        n_frames = 24
+        arrivals: list = []
+        errors: list = []
+
+        def drive():
+            try:
+                client.open(session="mttr", tenant="t1")
+                for index in range(n_frames):
+                    client.send_frame(
+                        {"x": [float(index + 1)] * 64})
+                for _ in range(n_frames):
+                    message = client.next_result(timeout=60.0)
+                    arrivals.append(
+                        (time_module.perf_counter(),
+                         message["frame"], message["ok"]))
+                client.close()
+            except Exception as error:
+                errors.append(f"{type(error).__name__}: {error}")
+
+        thread = threading.Thread(target=drive, daemon=True)
+        thread.start()
+        runtime.run(until=lambda: len(arrivals) >= 4 or errors,
+                    timeout=60.0)
+        kill_at = time_module.perf_counter()
+        delivered_before = len(arrivals)
+        p1.kill()
+        runtime.run(until=lambda: not thread.is_alive(),
+                    timeout=120.0)
+        if errors or thread.is_alive():
+            result["pipeline_failover_error"] = \
+                errors[0] if errors else "mttr pass hung"
+        else:
+            after = [stamp for stamp, _frame, _ok in
+                     arrivals[delivered_before:]
+                     if stamp > kill_at]
+            frame_ids = [frame for _stamp, frame, _ok in arrivals]
+            result.update({
+                "pipeline_failover_mttr_ms": round(
+                    (after[0] - kill_at) * 1000.0, 2) if after
+                else None,
+                "failover_frames_delivered": len(arrivals),
+                "failover_in_order_no_dups":
+                    frame_ids == list(range(n_frames)),
+                "failover_all_ok": all(
+                    ok for _stamp, _frame, ok in arrivals),
+            })
+    finally:
+        try:
+            gateway.stop()
+        except Exception:
+            pass
+        runtime.terminate()
+
+    # -- rolling restart of a 2-pipeline fleet under the loadgen ---------
+    runtime = fresh_runtime()
+    try:
+        Registrar(runtime=runtime, primary_search_timeout=0.05)
+        fleet = {"a": make_pipeline(runtime, "roll1", True, 8.0)}
+        gateway = GatewayServer(runtime=runtime)
+        runtime.run(until=lambda: len(gateway._peers) == 1,
+                    timeout=10.0)
+        fleet["b"] = make_pipeline(runtime, "roll2", True, 8.0)
+        runtime.run(until=lambda: len(gateway._peers) == 2,
+                    timeout=10.0)
+        rate = 30.0
+        seconds = 4.0
+        spec = LoadSpec("t1", "standard", rate=rate,
+                        frames=int(rate * seconds),
+                        data={"x": [1.0] * 64}, window=16)
+        box: dict = {}
+
+        def drive_load():
+            try:
+                box["report"] = run_loadgen("127.0.0.1", gateway.port,
+                                            [spec])
+            except Exception as error:
+                box["error"] = f"{type(error).__name__}: {error}"
+
+        thread = threading.Thread(target=drive_load, daemon=True)
+        thread.start()
+        deadline = time_module.monotonic() + 1.0
+        runtime.run(until=lambda: time_module.monotonic() > deadline,
+                    timeout=5.0)
+        fleet["a"].drain()              # rolling walk, pipeline 1
+        runtime.run(
+            until=lambda: fleet["a"].share.get("drained"),
+            timeout=30.0)
+        fleet["a2"] = make_pipeline(runtime, "roll1", True, 8.0)
+        runtime.run(until=lambda: len(gateway._peers) == 2,
+                    timeout=10.0)
+        fleet["b"].drain()              # rolling walk, pipeline 2
+        runtime.run(
+            until=lambda: fleet["b"].share.get("drained"),
+            timeout=30.0)
+        runtime.run(until=lambda: not thread.is_alive(),
+                    timeout=120.0)
+        if "report" not in box:
+            result["failover_rolling_error"] = \
+                box.get("error", "loadgen hung")
+        else:
+            bucket = box["report"]["classes"]["standard"]
+            dropped = bucket["sent"] - bucket["ok"] \
+                - bucket["errors"] - bucket["rejected"] \
+                - bucket["busy"]
+            result.update({
+                "failover_rolling_frames": bucket["sent"],
+                "failover_rolling_ok": bucket["ok"],
+                "failover_rolling_frames_dropped": dropped,
+                "failover_rolling_p99_ms": bucket["p99_ms"],
+                "failover_rolling_restarts": 2,
+            })
+    finally:
+        try:
+            gateway.stop()
+        except Exception:
+            pass
+        runtime.terminate()
+
+    previous = _previous_bench()
+    for key in ("pipeline_journal_fps", "pipeline_nojournal_fps",
+                "pipeline_failover_mttr_ms",
+                "failover_rolling_p99_ms"):
+        prior = previous.get(key)
+        if prior and result.get(key):
+            result[f"{key}_vs_baseline"] = round(result[key] / prior,
+                                                 2)
+    return result
+
+
+# ---------------------------------------------------------------------------
 # 5. ASR real-time factor (BASELINE config 5): seconds of audio
 #    transcribed per wall-clock second, batch of chunks, one dispatch
 #    (mel frontend + encoder + KV-cached 128-token greedy decode all
@@ -2748,6 +2996,7 @@ def main() -> int:
             ("bench_pipeline_faults", bench_pipeline_faults),
             ("bench_pipeline_replicas", bench_pipeline_replicas),
             ("bench_pipeline_gateway", bench_pipeline_gateway),
+            ("bench_pipeline_failover", bench_pipeline_failover),
             ("bench_asr", lambda: bench_asr(rtt)),
             ("bench_speech_e2e", bench_speech_e2e)):
         if wanted and name.removeprefix("bench_") not in wanted:

@@ -38,6 +38,8 @@ DEFINITION_FIXTURES = {
     "bad_data_plane.json": "bad-parameter",
     "bad_qos.json": "bad-parameter",
     "bad_qos_tenant.json": "bad-parameter",
+    "bad_journal.json": "bad-parameter",
+    "bad_drain_timeout.json": "bad-parameter",
     "data_plane_on_local.json": "data-plane-on-local",
     "bad_source.py": "bad-source",
     "undeclared_host_input.json": "undeclared-host-input",
