@@ -235,8 +235,13 @@ ELEMENT_PARAMETERS: dict[tuple[str, str], dict[str, ParamSpec]] = {
             "per block (0 = host-driven decode)",
             number=True, minimum=0),
         "speculative": ParamSpec(
-            "speculative multi-token decoding mode",
-            choices=("off", "ngram", "draft")),
+            "speculative multi-token decoding mode (auto probes draft "
+            "vs plain at startup and keeps the winner)",
+            choices=("off", "ngram", "draft", "auto")),
+        "spec_autoprobe": ParamSpec(
+            "allow 'speculative: auto' to run its startup micro-probe "
+            "(off resolves auto to plain decode)",
+            choices=("on", "off", "true", "false", "0", "1")),
         "spec_tokens": ParamSpec(
             "draft tokens proposed per speculative step",
             number=True, minimum=1),
@@ -249,6 +254,13 @@ ELEMENT_PARAMETERS: dict[tuple[str, str], dict[str, ParamSpec]] = {
         "kv_pages": ParamSpec(
             "physical page-pool size (absent = full provisioning)",
             number=True, minimum=2),
+        "prefix_cache": ParamSpec(
+            "share KV pages across requests with a common prompt "
+            "prefix (copy-on-write; requires kv_page_tokens > 0)",
+            choices=("on", "off", "true", "false", "0", "1")),
+        "prefix_min_tokens": ParamSpec(
+            "shortest prompt the prefix cache will index or match",
+            number=True, minimum=1),
         "decode_block": ParamSpec(
             "fused decode steps per dispatch (host-pipelined path)",
             number=True, minimum=1),

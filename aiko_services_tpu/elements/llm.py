@@ -266,6 +266,8 @@ class LLM(PipelineElement):
         self._recover_streak = 0
         self._published_accepted = 0
         self._published_drafted = 0
+        self._published_prefix_hits = 0
+        self._published_prefix_lookups = 0
 
     # Model-config parameters, resolved ON THE EVENT LOOP (stream
     # parameter precedence reads the pipeline's current-stream context,
@@ -275,7 +277,8 @@ class LLM(PipelineElement):
                      "decode_block", "inflight", "max_slots",
                      "decode_block_tokens", "speculative", "spec_tokens",
                      "spec_window", "kv_page_tokens", "kv_pages",
-                     "decode_kernel", "sample_top_k")
+                     "decode_kernel", "sample_top_k", "prefix_cache",
+                     "prefix_min_tokens", "spec_autoprobe")
 
     def _resolve_model_params(self) -> dict:
         resolved = {}
@@ -378,6 +381,9 @@ class LLM(PipelineElement):
             kv_page_tokens=int(settings.get("kv_page_tokens", 0)),
             kv_pages=None if kv_pages is None else int(kv_pages),
             sample_top_k=int(settings.get("sample_top_k", 0)),
+            prefix_cache=settings.get("prefix_cache", False),
+            prefix_min_tokens=int(settings.get("prefix_min_tokens", 64)),
+            spec_autoprobe=settings.get("spec_autoprobe", True),
             fetch=None if ledger is None
             else (lambda tree: ledger.fetch(tree, label="llm_block")),
             fault_probe=self._fault_probe,
@@ -634,24 +640,46 @@ class LLM(PipelineElement):
                 if entry["tokens"] > 1:
                     telemetry.registry.observe("llm_tpot_ms",
                                                entry["tpot_ms"])
+        changed = False
+        hits = batcher.prefix_hits
+        lookups = batcher.prefix_lookups
+        if hits != self._published_prefix_hits \
+                or lookups != self._published_prefix_lookups:
+            changed = True
+            if telemetry is not None:
+                telemetry.registry.count(
+                    "llm_prefix_hits",
+                    hits - self._published_prefix_hits)
+                telemetry.registry.count(
+                    "llm_prefix_lookups",
+                    lookups - self._published_prefix_lookups)
+            self._published_prefix_hits = hits
+            self._published_prefix_lookups = lookups
         accepted = batcher.accepted_tokens
         drafted = batcher.draft_tokens
-        if accepted == self._published_accepted \
-                and drafted == self._published_drafted:
+        if accepted != self._published_accepted \
+                or drafted != self._published_drafted:
+            changed = True
+            if telemetry is not None:
+                telemetry.registry.count(
+                    "llm_accepted_tokens",
+                    accepted - self._published_accepted)
+                telemetry.registry.count(
+                    "llm_draft_tokens",
+                    drafted - self._published_drafted)
+            self._published_accepted = accepted
+            self._published_drafted = drafted
+        if not changed:
             return
-        if telemetry is not None:
-            telemetry.registry.count(
-                "llm_accepted_tokens",
-                accepted - self._published_accepted)
-            telemetry.registry.count(
-                "llm_draft_tokens", drafted - self._published_drafted)
-        self._published_accepted = accepted
-        self._published_drafted = drafted
         pipeline = self.pipeline
 
         def update_share():
             pipeline.ec_producer.update("llm_accepted_tokens", accepted)
             pipeline.ec_producer.update("llm_draft_tokens", drafted)
+            pipeline.ec_producer.update("llm_prefix_hits", hits)
+            pipeline.ec_producer.update("llm_prefix_lookups", lookups)
+            pipeline.ec_producer.update("llm_spec_probe_ratio",
+                                        batcher.spec_probe_ratio)
         pipeline.runtime.engine.post_deferred(update_share)
 
     def _worker(self, work: "queue.Queue"):

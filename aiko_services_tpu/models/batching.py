@@ -81,6 +81,24 @@ __all__ = ["Request", "ContinuousBatcher", "MicroBatcher",
 # power-of-two compile shapes, each a fresh jit of the full model).
 _ADMISSION_BURST_MAX = 8
 
+# ``speculative: auto`` enables draft speculation only when the startup
+# micro-probe measures at least this tokens/s ratio over plain decode.
+SPEC_AUTO_MIN_RATIO = 1.2
+# Probe shape: warmup block (compile, off the clock) + timed blocks per
+# arm, best-of so a GC hiccup cannot flip the verdict.
+_SPEC_PROBE_BLOCKS = 3
+
+
+def _knob_on(value, default: bool) -> bool:
+    """on/off|true/false|bool -> bool, the same normalization the
+    create-time domain check applies to choice parameters."""
+    if isinstance(value, bool):
+        return value
+    text = str(value).strip().lower()
+    if not text:
+        return default
+    return text in ("on", "true", "1", "yes")
+
 
 def pad_to_bucket(rows: list) -> list:
     """Pad a ragged admission burst to its power-of-two compile bucket
@@ -171,7 +189,10 @@ class ContinuousBatcher:
                  fetch: Callable | None = None,
                  fault_probe: Callable | None = None,
                  on_block: Callable | None = None,
-                 sample_top_k: int = 0):
+                 sample_top_k: int = 0,
+                 prefix_cache: bool | str = False,
+                 prefix_min_tokens: int = 64,
+                 spec_autoprobe: bool | str = True):
         self.params = params
         # A pre-sharded (TP/fsdp) quantized tree must keep XLA's
         # matmul path -- resolved here, where the concrete leaves'
@@ -201,9 +222,21 @@ class ContinuousBatcher:
         # (analysis/params.py _check_value) normalizes, so a value
         # that passes preflight cannot fail here on case/whitespace.
         self.speculative = str(speculative or "off").strip().lower()
-        if self.speculative not in ("off", "ngram", "draft"):
+        if self.speculative not in ("off", "ngram", "draft", "auto"):
             raise ValueError(f"speculative={speculative!r}: one of "
-                             f"off|ngram|draft")
+                             f"off|ngram|draft|auto")
+        # ``auto`` (ISSUE 18): measure draft speculation against plain
+        # decode in a startup micro-probe and enable it only on a
+        # >= SPEC_AUTO_MIN_RATIO win -- auto never raises and never
+        # enables a losing config, so configs explicit ``draft`` would
+        # refuse (no device loop, ring too small) just resolve to off.
+        self.spec_autoprobe = _knob_on(spec_autoprobe, default=True)
+        self.spec_probe_ratio = 0.0
+        if self.speculative == "auto" and (
+                not self.device_loop
+                or self.decode_block_tokens < max(1, int(spec_tokens)) + 1
+                or not self.spec_autoprobe):
+            self.speculative = "off"
         if self.speculative != "off" and not self.device_loop:
             raise ValueError(
                 "speculative decoding rides the device loop: set "
@@ -239,6 +272,16 @@ class ContinuousBatcher:
         # Paged KV cache (models/paged.py): fixed-size pages + per-slot
         # page table; 0 keeps the monolithic [slots, max_seq] cache.
         self.kv_page_tokens = max(0, int(kv_page_tokens))
+        # Shared-prefix page cache (ISSUE 18): requests whose prompts
+        # share leading pages map ONE physical copy, refcounted, and
+        # skip prefill over the shared span.  Rides the page table, so
+        # it requires the paged cache.
+        self.prefix_cache = _knob_on(prefix_cache, default=False)
+        self.prefix_min_tokens = max(1, int(prefix_min_tokens))
+        if self.prefix_cache and not self.kv_page_tokens:
+            raise ValueError(
+                "prefix_cache: on shares KV at page granularity: set "
+                "kv_page_tokens > 0")
         self._pages: PageAllocator | None = None
         if self.kv_page_tokens:
             pps = pages_per_slot(self.max_seq, self.kv_page_tokens)
@@ -251,7 +294,9 @@ class ContinuousBatcher:
                 config, max_slots, self.max_seq, self.kv_page_tokens,
                 kv_pages)
             pool = llama.cache_array(self.cache).shape[1]
-            self._pages = PageAllocator(pool, pps, max_slots)
+            self._pages = PageAllocator(
+                pool, pps, max_slots, prefix_cache=self.prefix_cache,
+                prefix_min_tokens=self.prefix_min_tokens)
         else:
             self.cache = llama.init_cache(config, max_slots, self.max_seq)
         # Multichip serving: ``cache_put`` places the initial KV cache
@@ -319,9 +364,20 @@ class ContinuousBatcher:
         self.draft_tokens = 0
         self.evictions = 0
         self.recoveries = 0
+        # prefix-cache accounting (ISSUE 18): prompt tokens admission
+        # skipped because their pages were adopted from the index.
+        self.prefix_shared_tokens = 0
         # per-request latency stamps drained by the serving element
         # into the telemetry plane (llm_ttft_ms / llm_tpot_ms).
         self._request_stats: list[dict] = []
+        # ``speculative: auto``: measure, then commit to draft or off.
+        if self.speculative == "auto":
+            self.spec_probe_ratio = self._spec_probe()
+            if self.spec_probe_ratio >= SPEC_AUTO_MIN_RATIO:
+                self.speculative = "draft"
+                self._draft = draft_params(params)
+            else:
+                self.speculative = "off"
 
     # -- admission ---------------------------------------------------------
 
@@ -358,6 +414,16 @@ class ContinuousBatcher:
             request = self._next_pending()
             request.slot = slot
             request.prefill_pos = 0
+            if self._pages is not None and self.prefix_cache:
+                # Shared-prefix adoption (ISSUE 18): map the longest
+                # indexed page chain matching this prompt read-only
+                # and start prefill past it -- the skipped span never
+                # touches the device.
+                shared = self._pages.adopt_prefix(
+                    slot, request.prompt_tokens, self.kv_page_tokens)
+                if shared:
+                    request.prefill_pos = shared
+                    self.prefix_shared_tokens += shared
             request.admit_seq = self._admit_seq
             self._admit_seq += 1
             self.slots[slot] = request
@@ -498,6 +564,14 @@ class ContinuousBatcher:
         prompt = request.prompt_tokens
         self.prefill_tokens += start + chunk_len - request.prefill_pos
         request.prefill_pos = start + chunk_len
+        if self._pages is not None and self.prefix_cache:
+            # Index every whole prompt page now written: the content
+            # is position-deterministic, so the pages can serve any
+            # later prompt sharing this prefix (register as we go --
+            # even a mid-admission chain is adoptable).
+            self._pages.register_prefix(slot, prompt,
+                                        request.prefill_pos,
+                                        self.kv_page_tokens)
         if request.prefill_pos < len(prompt):
             self._prefilling.append(slot)       # more chunks to go
             return
@@ -695,6 +769,69 @@ class ContinuousBatcher:
                 self.current[slot] = token
                 self._emit(request, token)
 
+    # -- speculative auto-probe (ISSUE 18) ---------------------------------
+
+    def _spec_probe(self) -> float:
+        """Measure draft speculation against plain decode on a SCRATCH
+        cache (identical shapes to serving; ``self.cache`` is never
+        touched) and return spec tokens/s over plain tokens/s.  Each
+        arm pays one warmup block for compile, then the best of
+        ``_SPEC_PROBE_BLOCKS`` timed blocks counts -- a host hiccup on
+        one block must not flip the verdict."""
+        ring = self.decode_block_tokens
+        draft = draft_params(self.params)
+        tokens = jnp.zeros(self.max_slots, dtype=jnp.int32)
+        lengths = jnp.full(self.max_slots, self.max_seq // 2,
+                           dtype=jnp.int32)
+        active = jnp.ones(self.max_slots, dtype=bool)
+        temps = jnp.zeros(self.max_slots, dtype=jnp.float32)
+        eos = jnp.full((self.max_slots, 1), -1, dtype=jnp.int32)
+        history = jnp.full((self.max_slots, 1), -1, dtype=jnp.int32)
+        rates = {}
+        for mode, dparams in (("off", None), ("draft", draft)):
+            cache = self._probe_cache()
+            key = jax.random.PRNGKey(0)
+            best = 0.0
+            for index in range(_SPEC_PROBE_BLOCKS + 1):
+                budget = jnp.full(self.max_slots, ring,
+                                  dtype=jnp.int32)
+                begin = time.perf_counter()
+                (_, counts, tokens, _, _, _, history, key, _, _, _,
+                 cache) = llama.decode_loop(
+                    self.params, self.config, tokens, cache, lengths,
+                    active, budget, temps, eos, history, key,
+                    ring=ring, speculative=mode,
+                    spec_tokens=self.spec_tokens,
+                    spec_window=self.spec_window, draft=dparams,
+                    top_k=self.sample_top_k)
+                emitted = int(np.asarray(jax.device_get(counts)).sum())
+                elapsed = time.perf_counter() - begin
+                if index and elapsed > 0:       # block 0 = compile
+                    best = max(best, emitted / elapsed)
+            rates[mode] = best
+        return rates["draft"] / rates["off"] if rates["off"] else 0.0
+
+    def _probe_cache(self):
+        """A scratch serving cache for the probe.  Paged configs get a
+        fully-mapped table (each slot's logical pages spread over the
+        pool) so the probe pays real gather/scatter traffic instead of
+        the all-trash-page fast case."""
+        if not self.kv_page_tokens:
+            cache = llama.init_cache(self.config, self.max_slots,
+                                     self.max_seq)
+        else:
+            cache = init_paged_cache(
+                self.config, self.max_slots, self.max_seq,
+                self.kv_page_tokens, self._pages.total)
+            pps = self._pages.pps
+            table = (np.arange(self.max_slots * pps, dtype=np.int32)
+                     % max(1, self._pages.total - 1)) + 1
+            cache["page_table"] = jnp.asarray(
+                table.reshape(self.max_slots, pps))
+        if self._cache_put is not None:
+            cache = self._cache_put(cache)
+        return cache
+
     # -- device-resident generation loop (ISSUE 8) -------------------------
 
     def _host_state(self):
@@ -794,7 +931,8 @@ class ContinuousBatcher:
             self.params, self.config, tokens, self.cache, lengths,
             active, budget, temps_dev, eos_dev, history, key,
             ring=ring, speculative=self.speculative,
-            spec_tokens=self.spec_tokens, draft=self._draft,
+            spec_tokens=self.spec_tokens,
+            spec_window=self.spec_window, draft=self._draft,
             top_k=self.sample_top_k)
         # Only what the retire actually reads rides the counted fetch
         # (the active/budget/history carries chain device-side).
@@ -1048,6 +1186,29 @@ class ContinuousBatcher:
             self.resume_request(request, entry.get("committed", ()))
             count += 1
         return count
+
+    @property
+    def prefix_hits(self) -> int:
+        """Prompt pages adopted from the shared-prefix index."""
+        return self._pages.prefix_hits if self._pages is not None else 0
+
+    @property
+    def prefix_lookups(self) -> int:
+        """Whole prompt pages the index was consulted for."""
+        return self._pages.prefix_lookups \
+            if self._pages is not None else 0
+
+    def prefix_hit_rate(self) -> float:
+        """Adopted fraction of looked-up prompt pages (0.0 when the
+        cache is off or nothing was looked up)."""
+        lookups = self.prefix_lookups
+        return self.prefix_hits / lookups if lookups else 0.0
+
+    def reset_prefix_stats(self) -> None:
+        """Zero the hit/lookup counters (bench warm-phase isolation)."""
+        if self._pages is not None:
+            self._pages.prefix_hits = 0
+            self._pages.prefix_lookups = 0
 
     def take_request_stats(self) -> list[dict]:
         """Drain per-request latency stamps ({"ttft_ms", "tpot_ms",

@@ -1189,6 +1189,125 @@ def _history_push(history, candidates, cut):
     return jnp.take_along_axis(combined, index, axis=1)
 
 
+def _draft_window(draft, config: LlamaConfig, tokens, cache, lengths,
+                  active, k: int, window: int, trash: int):
+    """Amortized draft proposal (ISSUE 18): ``k`` greedy draft tokens
+    per row from ONE cache read.  The old draft loop re-dispatched
+    ``k`` full decode steps per iteration -- each streaming the whole
+    KV cache (and gathering every page of a paged cache) for ONE
+    cheap token, which is why r07/r08 measured draft speculation
+    SLOWER than plain decode.  Here the last ``window`` cache
+    positions of each row are gathered once ([B, W] per side, int8
+    windows dequantized small), and the k autoregressive draft steps
+    attend over window + the step's own scratch KV via
+    :func:`attention_prefill` with explicit key positions -- the
+    chunk-verify discipline.  Nothing is written back: verify's
+    optimistic writes land target-weight KV at exactly these
+    positions, so draft KV would be overwritten anyway.
+
+    The window is an APPROXIMATION of the full prefix (draft quality,
+    not correctness: the target verify accepts only matching tokens,
+    so a clipped-context draft can only lower acceptance, never change
+    output).  tokens/lengths/active: [B]; returns drafts [B, k]."""
+    c = config
+    b = tokens.shape[0]
+    w = int(window)
+    extent = cache_extent(cache)
+    rope_table = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    # Window = the last w valid positions of each row (clamped; rows
+    # shorter than w mask the underflow out).
+    wpos_raw = lengths[:, None] - w + jnp.arange(w)[None, :]   # [B, W]
+    wvalid = wpos_raw >= 0
+    wpos = jnp.clip(wpos_raw, 0, extent - 1)
+
+    def gather_window(side):
+        """One cache side -> the dequantized grouped window
+        [L, B, W, K, hd] -- the single full-cache read."""
+        if is_paged(cache):
+            pt = pool_page_tokens(cache)
+            linear = cache["page_table"][
+                jnp.arange(b)[:, None], wpos // pt] * pt + wpos % pt
+
+            def flat_take(arr):        # [L, P, pt, ...] pool
+                flat = arr.reshape(arr.shape[0], -1, *arr.shape[3:])
+                return flat[:, linear]             # [L, B, W, ...]
+            win = {"int8": flat_take(side["int8"]),
+                   "scale": flat_take(side["scale"])} \
+                if is_quantized(side) else flat_take(side)
+        else:
+            def row_take(arr, extra_dims):         # [L, B, T, ...]
+                index = wpos[None, :, :].reshape(
+                    1, b, w, *(1,) * extra_dims)
+                return jnp.take_along_axis(arr, index, axis=2)
+            win = {"int8": row_take(side["int8"], 1),
+                   "scale": row_take(side["scale"], 2)} \
+                if is_quantized(side) else row_take(side, 1)
+        win = _grouped(win, c.n_kv_heads)
+        if is_quantized(win):
+            win = dequantize_kv(win, _dtype(c))
+        return win.astype(_dtype(c))
+
+    win_k = gather_window(cache["k"])              # [L, B, W, K, hd]
+    win_v = gather_window(cache["v"])
+    # Scratch KV for the up-to-k draft tokens of THIS iteration; column
+    # j holds step j's keys/values at position lengths + j.
+    scratch_shape = (c.n_layers, b, k, c.n_kv_heads, c.head_dim)
+    spos = jnp.minimum(lengths[:, None] + jnp.arange(k)[None, :],
+                       trash)                      # [B, k]
+
+    def draft_step(carry, step):
+        current, scratch_k, scratch_v = carry
+        pos = jnp.where(active, jnp.minimum(lengths + step, trash),
+                        trash)[:, None]            # [B, 1]
+        svalid = jnp.broadcast_to(
+            (jnp.arange(k) < step)[None, :], (b, k))
+
+        def layer_step(carry2, xs):
+            hidden, aux = carry2
+            layer, wk_l, wv_l, sk_l, sv_l = xs
+
+            def kv_write(q, kk, vv):
+                q = apply_rope(q, rope_table, pos)
+                kk = apply_rope(kk, rope_table, pos)
+                kv_write.updated = (kk, vv)
+                k_all = jnp.concatenate(
+                    [wk_l, sk_l, kk.astype(wk_l.dtype)], axis=1)
+                v_all = jnp.concatenate(
+                    [wv_l, sv_l, vv.astype(wv_l.dtype)], axis=1)
+                kv_positions = jnp.concatenate(
+                    [wpos, spos, pos], axis=1)     # [B, W+k+1]
+                valid = jnp.concatenate(
+                    [wvalid, svalid, jnp.ones((b, 1), dtype=bool)],
+                    axis=1)
+                return attention_prefill(q, k_all, v_all, pos,
+                                         kv_length_mask=valid,
+                                         kv_positions=kv_positions)
+            hidden2, aux2 = _block(c, hidden, layer, kv_write)
+            return (hidden2, aux + aux2), kv_write.updated
+
+        hidden = draft["embed"][current[:, None]]  # [B, 1, D]
+        (hidden, _), updates = jax.lax.scan(
+            layer_step, (hidden, jnp.float32(0.0)),
+            (draft["layers"], win_k, win_v, scratch_k, scratch_v))
+        new_k, new_v = updates                     # [L, B, 1, K, hd]
+        scratch_k = jax.lax.dynamic_update_slice(
+            scratch_k, new_k.astype(scratch_k.dtype),
+            (0, 0, step, 0, 0))
+        scratch_v = jax.lax.dynamic_update_slice(
+            scratch_v, new_v.astype(scratch_v.dtype),
+            (0, 0, step, 0, 0))
+        logits = _finish(draft, c, hidden)         # [B, 1, V]
+        current = jnp.argmax(logits[:, 0, :], -1).astype(jnp.int32)
+        return (current, scratch_k, scratch_v), current
+
+    carry = (tokens,
+             jnp.zeros(scratch_shape, dtype=win_k.dtype),
+             jnp.zeros(scratch_shape, dtype=win_v.dtype))
+    _, drafts = jax.lax.scan(draft_step, carry,
+                             jnp.arange(k, dtype=jnp.int32))
+    return drafts.T                                # [B, k]
+
+
 def _chunk_verify(params, config: LlamaConfig, chunk, cache, starts,
                   trash: int, use_flash: bool = False):
     """One batched multi-token target step: forward ``chunk`` [B, S]
@@ -1294,7 +1413,7 @@ def _chunk_verify(params, config: LlamaConfig, chunk, cache, starts,
 
 @partial(jax.jit,
          static_argnames=("config", "ring", "speculative", "spec_tokens",
-                          "use_flash", "top_k"),
+                          "spec_window", "use_flash", "top_k"),
          donate_argnames=("cache",))
 def _decode_loop_jit(params: dict, draft: dict, config: LlamaConfig,
                      tokens: jax.Array, cache: dict, lengths: jax.Array,
@@ -1302,7 +1421,7 @@ def _decode_loop_jit(params: dict, draft: dict, config: LlamaConfig,
                      temperatures: jax.Array, eos: jax.Array,
                      history: jax.Array, key: jax.Array, *, ring: int,
                      speculative: str, spec_tokens: int,
-                     use_flash: bool, top_k: int = 0):
+                     spec_window: int, use_flash: bool, top_k: int = 0):
     """The device-resident serving loop: up to ``ring`` tokens per row
     generated inside ONE dispatch, with sampling, per-slot stop
     detection (EOS + budget + cache boundary) and speculative
@@ -1372,22 +1491,13 @@ def _decode_loop_jit(params: dict, draft: dict, config: LlamaConfig,
         if speculative == "ngram":
             drafts = _ngram_draft(history, tokens, k)        # [B, k]
         else:
-            # Self-drafting from the quantized tree: k cheap decode
-            # steps whose KV writes the verify pass overwrites with
-            # target-weight KV at the same positions.
-            def draft_step(carry2, step):
-                current, cache2 = carry2
-                pos = jnp.where(active,
-                                jnp.minimum(lengths + step, trash), trash)
-                logits2, cache2 = _decode_step_impl(
-                    draft, config, current, cache2, pos,
-                    use_flash=use_flash)
-                current = jnp.argmax(logits2, -1).astype(jnp.int32)
-                return (current, cache2), current
-            (_, cache), drafts = jax.lax.scan(
-                draft_step, (tokens, cache),
-                jnp.arange(k, dtype=jnp.int32))
-            drafts = drafts.T                                # [B, k]
+            # Self-drafting from the quantized tree, amortized (ISSUE
+            # 18): one window gather, k tiny attention steps, zero
+            # cache writes -- verify lands target-weight KV at the
+            # same positions (see _draft_window).
+            drafts = _draft_window(draft, config, tokens, cache,
+                                   lengths, active, k, spec_window,
+                                   trash)                    # [B, k]
         chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
         starts = jnp.where(active, jnp.minimum(lengths, trash), trash)
         logits, cache = _chunk_verify(params, config, chunk, cache,
@@ -1447,11 +1557,13 @@ def decode_loop(params: dict, config: LlamaConfig, tokens: jax.Array,
                 budget: jax.Array, temperatures: jax.Array,
                 eos: jax.Array, history: jax.Array, key: jax.Array, *,
                 ring: int, speculative: str = "off",
-                spec_tokens: int = 4, draft: dict | None = None,
-                top_k: int = 0):
+                spec_tokens: int = 4, spec_window: int = 32,
+                draft: dict | None = None, top_k: int = 0):
     """Device-resident generation block (see _decode_loop_jit); the
     flash-vs-dense choice resolves here on the concrete cache's
-    sharding/structure, exactly as in :func:`decode_step`."""
+    sharding/structure, exactly as in :func:`decode_step`.
+    ``speculative: auto`` resolves in the ContinuousBatcher's startup
+    probe (models/batching.py), never here."""
     if speculative not in ("off", "ngram", "draft"):
         raise ValueError(
             f"speculative={speculative!r}: one of off|ngram|draft")
@@ -1461,6 +1573,7 @@ def decode_loop(params: dict, config: LlamaConfig, tokens: jax.Array,
                             budget, temperatures, eos, history, key,
                             ring=int(ring), speculative=speculative,
                             spec_tokens=int(spec_tokens),
+                            spec_window=max(1, int(spec_window)),
                             top_k=int(top_k),
                             use_flash=_resolve_decode_flash(config, cache))
 

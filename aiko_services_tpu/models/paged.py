@@ -51,7 +51,8 @@ from .quant import is_quantized
 
 __all__ = ["PageAllocator", "init_paged_cache", "is_paged",
            "pages_per_slot", "pool_page_tokens", "paged_extent",
-           "gather_layer", "gather_slot", "scatter_pages"]
+           "gather_layer", "gather_slot", "scatter_pages",
+           "prefix_page_keys"]
 
 
 def pages_per_slot(max_seq: int, page_tokens: int) -> int:
@@ -162,15 +163,53 @@ def gather_slot(layer, table_row):
     return _gather(layer, table_row)[None]
 
 
+_PREFIX_SEED = 0x9E3779B97F4A7C15
+
+
+def prefix_page_keys(tokens, page_tokens: int, limit: int | None = None):
+    """Rolling prefix-hash chain for ``tokens``: one key per WHOLE page
+    the sequence covers, each key a function of every token up to and
+    including that page (so two chains agree exactly on their common
+    prefix of identical pages).  ``limit`` caps the number of keys."""
+    pt = int(page_tokens)
+    pages = len(tokens) // pt
+    if limit is not None:
+        pages = min(pages, int(limit))
+    keys, h = [], _PREFIX_SEED
+    for p in range(pages):
+        h = hash((h, tuple(tokens[p * pt:(p + 1) * pt])))
+        keys.append(h)
+    return keys
+
+
 class PageAllocator:
     """Host-side free list + per-slot page assignments.  Owned by the
     ContinuousBatcher (single-threaded with its step loop); the device
     page table is updated from :attr:`dirty` rows folded into the next
     dispatch, so allocation never costs a device round trip of its
-    own."""
+    own.
+
+    Prefix cache (ISSUE 18, ``prefix_cache=True``): prompt-covering
+    pages are additionally keyed by a rolling prefix hash of the tokens
+    they hold (:func:`prefix_page_keys`).  A later request whose prompt
+    starts with the same page chain ADOPTS those physical pages
+    read-only -- its table row points at the donor's pages and its
+    prefill starts past the shared span.  Correctness rests on KV
+    position-determinism: K/V at position ``i`` are a pure function of
+    ``(token_i, i)``, so identical tokens at identical positions yield
+    byte-identical pages, and a clamped admission chunk re-scattering a
+    shared page rewrites it with the very same bytes.  Sharing is
+    refcounted per physical page (mapping slots + 1 while indexed);
+    "copy-on-write at the first divergent page" means the divergent
+    page is simply never mapped -- the adopter allocates a fresh page
+    there and prefills it, leaving the donor untouched.  The index
+    itself holds a reference, so warm pages survive their slot and
+    serve the next request; under pool pressure :meth:`ensure` reclaims
+    index-only (refcount-1) entries leaf-first."""
 
     def __init__(self, total_pages: int, pages_per_slot: int,
-                 max_slots: int):
+                 max_slots: int, prefix_cache: bool = False,
+                 prefix_min_tokens: int = 64):
         self.total = int(total_pages)
         self.pps = int(pages_per_slot)
         self.max_slots = int(max_slots)
@@ -180,6 +219,21 @@ class PageAllocator:
         self._slots: dict[int, dict[int, int]] = {}
         # slot -> host table row pending upload (numpy-friendly lists).
         self.dirty: dict[int, list[int]] = {}
+        # -- prefix cache ------------------------------------------------
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix_min_tokens = int(prefix_min_tokens)
+        # phys page -> holders (mapping slots, +1 while in the index).
+        self._refs: dict[int, int] = {}
+        # prefix key -> phys page, insertion order == LRU order (hits
+        # and registrations re-insert).  _key_of inverts it for
+        # release-time decref; _children drives leaf-first reclaim.
+        self._prefix: dict[int, int] = {}
+        self._key_of: dict[int, int] = {}
+        self._parent: dict[int, int | None] = {}
+        self._children: dict[int, int] = {}
+        # hit accounting for telemetry/bench (host-side, resettable).
+        self.prefix_hits = 0            # pages adopted from the index
+        self.prefix_lookups = 0         # whole prompt pages looked up
 
     @property
     def free_pages(self) -> int:
@@ -202,11 +256,14 @@ class PageAllocator:
     def ensure(self, slot: int, pages: int) -> bool:
         """Allocate (atomically) whatever logical pages [0, pages) the
         slot is missing.  False (and no change) when the free list
-        cannot cover them."""
+        cannot cover them -- after reclaiming unreferenced prefix-index
+        entries leaf-first when the cache is on."""
         pages = min(int(pages), self.pps)
         owned = self._slots.setdefault(slot, {})
         wanted = [logical for logical in range(pages)
                   if logical not in owned]
+        if len(wanted) > len(self._free):
+            self._reclaim(len(wanted) - len(self._free))
         if len(wanted) > len(self._free):
             return False
         if wanted:
@@ -218,20 +275,175 @@ class PageAllocator:
         return True
 
     def release(self, slot: int) -> int:
-        """Return every page the slot holds to the pool (slot finish,
-        cancel, eviction) and mark its table row for reset."""
+        """Drop the slot's claim on every page it holds (slot finish,
+        cancel, eviction) and mark its table row for reset.  Pages the
+        prefix index (or another adopter) still references stay
+        allocated; the rest return to the free list."""
         owned = self._slots.pop(slot, {})
         if not owned:
             return 0
-        self._free.extend(sorted(owned.values(), reverse=True))
+        freed = []
+        for phys in owned.values():
+            refs = self._refs.get(phys, 1) - 1
+            if refs <= 0:
+                self._refs.pop(phys, None)
+                self._unindex(phys)
+                freed.append(phys)
+            else:
+                self._refs[phys] = refs
+        self._free.extend(sorted(freed, reverse=True))
         self.dirty[slot] = [0] * self.pps
         return len(owned)
 
     def reset(self) -> None:
-        """Forget everything (device state was rebuilt)."""
+        """Forget everything (device state was rebuilt).  The prefix
+        index goes too: recover/failover re-initialized the pool, so
+        cached page CONTENT no longer exists -- the cache restarts
+        cold."""
         self._free = list(range(self.total - 1, 0, -1))
         self._slots.clear()
         self.dirty.clear()
+        self._refs.clear()
+        self._prefix.clear()
+        self._key_of.clear()
+        self._parent.clear()
+        self._children.clear()
+
+    # -- prefix cache ------------------------------------------------------
+
+    def match_prefix(self, tokens, page_tokens: int) -> int:
+        """How many leading WHOLE pages of ``tokens`` the index can
+        supply.  Capped one page short of covering the full prompt:
+        at least one token must prefill so the first generated token
+        has last-position logits to sample from."""
+        if not self.prefix_cache \
+                or len(tokens) < self.prefix_min_tokens:
+            return 0
+        limit = min(self.pps, (len(tokens) - 1) // int(page_tokens))
+        matched = 0
+        for key in prefix_page_keys(tokens, page_tokens, limit):
+            if key not in self._prefix:
+                break
+            matched += 1
+        return matched
+
+    def adopt_prefix(self, slot: int, tokens, page_tokens: int) -> int:
+        """Map the longest indexed page chain matching ``tokens`` into
+        ``slot`` read-only (refcount +1 per page) and return the token
+        count covered -- the span admission skips.  The slot must hold
+        no pages yet (fresh admission).  Counts lookups/hits for the
+        hit-rate metric whenever the cache is consulted."""
+        if not self.prefix_cache \
+                or len(tokens) < self.prefix_min_tokens:
+            return 0
+        pt = int(page_tokens)
+        limit = min(self.pps, (len(tokens) - 1) // pt)
+        self.prefix_lookups += max(0, limit)
+        owned = self._slots.setdefault(slot, {})
+        if owned:
+            return 0
+        row = None
+        for logical, key in enumerate(
+                prefix_page_keys(tokens, pt, limit)):
+            phys = self._prefix.get(key)
+            if phys is None:
+                break
+            if row is None:
+                row = self.dirty.setdefault(slot, self._row(slot))
+            self._refs[phys] = self._refs.get(phys, 1) + 1
+            owned[logical] = phys
+            row[logical] = phys
+            # LRU bump: re-insert at the MRU end.
+            self._prefix.pop(key)
+            self._prefix[key] = phys
+            self.prefix_hits += 1
+        return len(owned) * pt
+
+    def register_prefix(self, slot: int, tokens, upto: int,
+                        page_tokens: int) -> None:
+        """Index every whole page of ``tokens[:upto]`` the slot holds
+        (admission progressed to ``upto``).  Indexing a page takes a
+        reference, so the content outlives the slot; already-indexed
+        pages (including ones this slot adopted) are left alone -- the
+        index keeps ONE canonical physical page per prefix key."""
+        if not self.prefix_cache \
+                or len(tokens) < self.prefix_min_tokens:
+            return
+        pt = int(page_tokens)
+        owned = self._slots.get(slot, {})
+        limit = min(self.pps, max(0, int(upto)) // pt,
+                    len(tokens) // pt)
+        parent = None
+        for logical, key in enumerate(
+                prefix_page_keys(tokens, pt, limit)):
+            phys = owned.get(logical)
+            if phys is None:
+                break
+            held = self._prefix.get(key)
+            if held is None and self._key_of.get(phys) is None:
+                self._prefix[key] = phys
+                self._key_of[phys] = key
+                self._refs[phys] = self._refs.get(phys, 1) + 1
+                self._parent[phys] = parent
+                if parent is not None:
+                    self._children[parent] = \
+                        self._children.get(parent, 0) + 1
+            elif held is not None:
+                # LRU bump for the canonical page of this prefix.
+                self._prefix.pop(key)
+                self._prefix[key] = held
+            canonical = held if held is not None else phys
+            parent = canonical
+
+    def _unindex(self, phys: int) -> None:
+        """Drop ``phys`` from the prefix index (its content is gone or
+        its refcount hit zero)."""
+        key = self._key_of.pop(phys, None)
+        if key is not None:
+            self._prefix.pop(key, None)
+        parent = self._parent.pop(phys, None)
+        if parent is not None and parent in self._children:
+            remaining = self._children[parent] - 1
+            if remaining <= 0:
+                self._children.pop(parent, None)
+            else:
+                self._children[parent] = remaining
+        self._children.pop(phys, None)
+
+    def _reclaim(self, need: int) -> int:
+        """Free up to ``need`` pages held ONLY by the prefix index
+        (refcount 1), leaf-first in LRU order, so pool pressure evicts
+        the cache before it preempts a live slot."""
+        if need <= 0 or not self._prefix:
+            return 0
+        reclaimed = 0
+        progress = True
+        while reclaimed < need and progress:
+            progress = False
+            for key, phys in list(self._prefix.items()):
+                if self._refs.get(phys, 0) != 1 \
+                        or self._children.get(phys, 0):
+                    continue            # mapped by a slot, or a parent
+                self._refs.pop(phys, None)
+                self._unindex(phys)
+                self._free.append(phys)
+                reclaimed += 1
+                progress = True
+                if reclaimed >= need:
+                    break
+        if reclaimed:
+            self._free.sort(reverse=True)
+        return reclaimed
+
+    def leaked_pages(self) -> int:
+        """Allocated pages no slot maps and the index does not hold --
+        0 in a healthy allocator (the zero-leak invariant tests
+        assert)."""
+        live = set()
+        for owned in self._slots.values():
+            live.update(owned.values())
+        live.update(self._key_of)
+        return self.total - 1 - len(self._free) - len(live)
 
     def _row(self, slot: int) -> list[int]:
         row = [0] * self.pps
@@ -241,6 +453,11 @@ class PageAllocator:
 
     @property
     def stats(self) -> dict:
-        return {"total": self.total, "free": self.free_pages,
-                "held": {slot: len(pages)
-                         for slot, pages in self._slots.items()}}
+        out = {"total": self.total, "free": self.free_pages,
+               "held": {slot: len(pages)
+                        for slot, pages in self._slots.items()}}
+        if self.prefix_cache:
+            out["prefix_pages"] = len(self._prefix)
+            out["prefix_hits"] = self.prefix_hits
+            out["prefix_lookups"] = self.prefix_lookups
+        return out

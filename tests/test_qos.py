@@ -164,6 +164,37 @@ def test_stage_scheduler_pops_best_ranked_waiter():
     assert waiter[1] == 0                   # same class: FIFO by seq
 
 
+def test_stage_credit_promotion_fires_on_promote_once():
+    """ISSUE 18 satellite: a near-deadline batch frame promotes AT THE
+    STAGE-CREDIT SEAM -- `_pop_ranked` lifts it over a standard frame
+    queued ahead of it and fires ``on_promote`` exactly once (the
+    callback Pipeline wires into ``share['qos_promotions']``), so the
+    counter the gateway bench reports is reachable deterministically."""
+    qos = QosScheduler({"promote_ms": 50, "age_ms": 0})
+    promoted = []
+    scheduler = StageScheduler(
+        ["llm"], depth=1, qos=qos,
+        on_promote=lambda sid, frame: promoted.append((sid, frame)))
+    ahead = frame_stub("standard", seq=1)
+    urgent = frame_stub("batch", seq=9,
+                        deadline=time.monotonic() + 0.02)
+    scheduler.enqueue("llm", ["s-ahead", 1, "llm", True, ahead])
+    scheduler.enqueue("llm", ["s-urgent", 9, "llm", True, urgent])
+    token = scheduler.next_waiter("llm")
+    # batch (rank 3) promoted to rank 0 beats standard (rank 2)
+    assert token[0] == "s-urgent"
+    assert urgent.qos_promoted
+    assert promoted == [("s-urgent", urgent)]
+    assert qos.promotions == 1
+    # the promoted frame requeues (stolen credit): re-ranking it must
+    # NOT fire the callback or bump the counter a second time
+    scheduler.cancel_reservation("llm")
+    scheduler.enqueue("llm", token, front=True)
+    again = scheduler.next_waiter("llm")
+    assert again[0] == "s-urgent"
+    assert len(promoted) == 1 and qos.promotions == 1
+
+
 def test_stage_scheduler_fifo_without_qos():
     scheduler = StageScheduler(["llm"], depth=1)
     assert scheduler.try_admit("llm")

@@ -465,6 +465,255 @@ def test_recover_paged_speculative(tiny_f32):
     assert batcher.recoveries == 1
 
 
+# -- shared-prefix KV: COW page sharing (ISSUE 18) -------------------------
+
+
+_SHARED_PREFIX = [3 + (i % 40) for i in range(32)]     # 2 whole pages
+
+
+def _drive_prefix(params, config, prompts, max_new=8,
+                  serial_first=False, **kw):
+    """Drain token-list prompts through one batcher ->
+    ({request_id: [tokens]}, batcher).  ``serial_first`` drains the
+    first request alone (priming the prefix index) before the rest."""
+    emitted = {}
+
+    def emit(request_id, token, finished):
+        emitted.setdefault(request_id, []).append(token)
+
+    defaults = dict(max_slots=4, max_seq=64, prefill_chunk=16,
+                    decode_block_tokens=8, kv_page_tokens=16,
+                    prefix_cache=True, prefix_min_tokens=16)
+    defaults.update(kw)
+    batcher = ContinuousBatcher(params, config, **defaults)
+    for i, prompt in enumerate(prompts):
+        batcher.submit(Request(request_id=f"r{i}",
+                               prompt_tokens=list(prompt),
+                               max_new_tokens=max_new, emit=emit))
+        if serial_first and i == 0:
+            assert batcher.run_until_drained(max_steps=3000) < 3000
+    assert batcher.run_until_drained(max_steps=3000) < 3000
+    return emitted, batcher
+
+
+def test_prefix_cache_warm_matches_cold(tiny_f32):
+    """The tentpole equivalence contract: a request admitted onto
+    SHARED prefix pages (prefill skipped for the whole shared span)
+    emits the exact token stream of an unshared cold prefill, the
+    index serves the warm request (hits recorded), and no page leaks."""
+    config, params = tiny_f32
+    prompts = [_SHARED_PREFIX + [100 + i, 50 + i, 7, 11 + i, 2, 9, 4, 1]
+               for i in range(3)]
+    cold, cold_b = _drive_prefix(params, config, prompts,
+                                 serial_first=True, prefix_cache=False)
+    warm, warm_b = _drive_prefix(params, config, prompts,
+                                 serial_first=True)
+    assert cold == warm
+    # r0 primes the index; r1/r2 adopt both shared pages each.
+    assert warm_b.prefix_hits >= 4
+    assert warm_b.prefix_shared_tokens >= 64
+    assert warm_b.prefix_hit_rate() > 0.0
+    assert cold_b.prefix_hits == 0            # off = no index traffic
+    assert warm_b._pages.leaked_pages() == 0
+    assert cold_b._pages.leaked_pages() == 0
+
+
+def test_prefix_divergence_cow_leaves_donor_untouched(tiny_f32):
+    """COW at the divergence point: the adopter maps the donor's
+    shared pages PHYSICALLY (same table entries), allocates a fresh
+    page where the prompts diverge, and the donor's cache bytes over
+    the shared span stay bit-identical while both keep generating."""
+    config, params = tiny_f32
+    pA = _SHARED_PREFIX + [100 + i for i in range(8)]
+    pB = _SHARED_PREFIX + [70 + i for i in range(8)]
+    emitted = {}
+
+    def emit(request_id, token, finished):
+        emitted.setdefault(request_id, []).append(token)
+
+    batcher = ContinuousBatcher(params, config, max_slots=3, max_seq=64,
+                                prefill_chunk=16, decode_block_tokens=4,
+                                inflight=1, kv_page_tokens=16,
+                                prefix_cache=True, prefix_min_tokens=16)
+    batcher.submit(Request(request_id="A", prompt_tokens=list(pA),
+                           max_new_tokens=20, emit=emit))
+    while len(emitted.get("A", ())) < 4:
+        batcher.step()
+    assert batcher.blocks_in_flight == 0         # inflight=1 quiesces
+    slot_a = next(i for i, r in enumerate(batcher.slots)
+                  if r is not None and r.request_id == "A")
+
+    def snapshot():
+        row = batcher.cache["page_table"][slot_a]
+        k = np.stack([np.asarray(gather_slot(batcher.cache["k"][layer],
+                                             row)[0])[:32]
+                      for layer in range(config.n_layers)])
+        v = np.stack([np.asarray(gather_slot(batcher.cache["v"][layer],
+                                             row)[0])[:32]
+                      for layer in range(config.n_layers)])
+        return k, v
+
+    before = snapshot()
+    batcher.submit(Request(request_id="B", prompt_tokens=list(pB),
+                           max_new_tokens=6, emit=emit))
+    slot_b = None
+    for _ in range(100):
+        batcher.step()
+        slot_b = next((i for i, r in enumerate(batcher.slots)
+                       if r is not None and r.request_id == "B"), None)
+        if slot_b is not None:
+            break
+    assert slot_b is not None
+    table = np.asarray(jax.device_get(batcher.cache["page_table"]))
+    # the shared span is the SAME physical pages; the divergent page
+    # (logical 2, where the prompts' tails differ) is a fresh copy.
+    np.testing.assert_array_equal(table[slot_a][:2], table[slot_b][:2])
+    assert table[slot_b][2] not in (0, table[slot_a][2])
+    while len(emitted.get("B", ())) < 6:         # B finishes; A lives
+        batcher.step()
+    assert batcher.slots[slot_a] is not None
+    assert batcher.slots[slot_a].request_id == "A"
+    after = snapshot()
+    np.testing.assert_array_equal(before[0], after[0])
+    np.testing.assert_array_equal(before[1], after[1])
+    assert batcher.run_until_drained(max_steps=2000) < 2000
+    # B's stream equals an unshared run of the same prompts.
+    cold, _ = _drive_prefix(params, config, [pA, pB],
+                            max_new=6, prefix_cache=False,
+                            decode_block_tokens=4, inflight=1,
+                            max_slots=3)
+    assert emitted["B"] == cold["r1"]
+    assert batcher._pages.leaked_pages() == 0
+
+
+def test_prefix_cache_refcounts_survive_eviction_and_recover(tiny_f32):
+    """Refcounts reach zero on every exit path: pool-pressure
+    eviction of shared-prefix requests, stream drain, and a full
+    recover() all leave zero leaked pages -- and the pressured shared
+    run still emits the exact unshared streams."""
+    config, params = tiny_f32
+    prompts = [_SHARED_PREFIX + [120 + i, 8, 90 + i, 5, 60 + i, 3,
+                                 40 + i, 2] for i in range(4)]
+    cold, _ = _drive_prefix(params, config, prompts, max_new=24,
+                            serial_first=True, prefix_cache=False)
+    pressed, batcher = _drive_prefix(params, config, prompts,
+                                     max_new=24, serial_first=True,
+                                     decode_block_tokens=4,
+                                     kv_pages=8)
+    assert cold == pressed
+    assert batcher.evictions >= 1
+    assert batcher._pages.leaked_pages() == 0
+    batcher.recover()                            # cold cache, no leaks
+    assert batcher._pages.leaked_pages() == 0
+    assert batcher._pages.free_pages == batcher._pages.total - 1
+    assert batcher._pages.stats["prefix_pages"] == 0
+
+
+def test_prefix_chaos_kill_and_journal_adoption_no_leaks(tiny_f32):
+    """The chaos walk of the acceptance criteria: a ``decode_block``
+    kill mid-generation over SHARED pages, recover(), then journal
+    adoption (``resume_request``) of a shared-prefix request -- the
+    adopted request rides the re-registered index, emits exactly its
+    remaining budget, and the pool ends with zero leaked pages."""
+    config, params = tiny_f32
+    prompts = [_SHARED_PREFIX + [100 + i, 9, 80 + i, 6, 30 + i, 1,
+                                 20 + i, 4] for i in range(4)]
+    emitted = {}
+
+    def emit(request_id, token, finished):
+        emitted.setdefault(request_id, []).append(token)
+
+    fired = {"n": 0}
+
+    def probe(point):
+        assert point == "decode_block"
+        fired["n"] += 1
+        if fired["n"] == 3:
+            raise RuntimeError("injected chip death")
+
+    batcher = ContinuousBatcher(params, config, max_slots=4, max_seq=64,
+                                prefill_chunk=16, decode_block_tokens=4,
+                                inflight=1, kv_page_tokens=16,
+                                prefix_cache=True, prefix_min_tokens=16,
+                                fault_probe=probe)
+    for i, prompt in enumerate(prompts):
+        batcher.submit(Request(request_id=f"r{i}",
+                               prompt_tokens=list(prompt),
+                               max_new_tokens=10, emit=emit))
+    steps = 0
+    while (batcher.pending or batcher.active_count
+           or batcher.blocks_in_flight) and steps < 3000:
+        try:
+            batcher.step()
+        except RuntimeError:
+            assert batcher.recover() >= 1        # refcounts reset too
+            assert batcher._pages.leaked_pages() == 0
+        steps += 1
+    assert steps < 3000 and batcher.recoveries == 1
+    host, _ = _drive_prefix(params, config, prompts, max_new=10,
+                            prefix_cache=False, kv_page_tokens=0)
+    assert emitted == host                       # kill lost nothing
+    # journal adoption: a peer's shared-prefix request resumes at its
+    # committed prefix and generates only the remaining budget.
+    adopted = Request(request_id="adopted",
+                      prompt_tokens=_SHARED_PREFIX + [100, 9, 80, 6,
+                                                      30, 1, 20, 4],
+                      max_new_tokens=10, emit=emit)
+    batcher.submit(adopted)
+    committed = host["r0"][:4]
+    assert batcher.resume_request(adopted, committed)
+    assert batcher.run_until_drained(max_steps=2000) < 2000
+    assert emitted["adopted"] == host["r0"][4:]
+    assert batcher.prefix_hits >= 1              # rode the warm index
+    assert batcher._pages.leaked_pages() == 0
+
+
+def test_prefix_page_allocator_units():
+    """Allocator-level arithmetic for the prefix index: hash-chain
+    agreement, match capped one page short, adoption refcounts,
+    release keeping indexed pages warm, and leaf-first reclaim under
+    pool pressure."""
+    from aiko_services_tpu.models.paged import prefix_page_keys
+
+    tokens = list(range(40))
+    keys = prefix_page_keys(tokens, 16)
+    assert len(keys) == 2                        # whole pages only
+    assert prefix_page_keys(tokens[:32], 16) == keys
+    divergent = tokens[:16] + [999] * 24
+    other = prefix_page_keys(divergent, 16)
+    assert other[0] == keys[0] and other[1] != keys[1]
+
+    alloc = PageAllocator(total_pages=9, pages_per_slot=4, max_slots=3,
+                          prefix_cache=True, prefix_min_tokens=16)
+    assert alloc.match_prefix(tokens, 16) == 0   # nothing indexed yet
+    assert alloc.ensure(0, 3)
+    alloc.register_prefix(0, tokens, 40, 16)     # indexes 2 pages
+    assert alloc.match_prefix(tokens, 16) == 2
+    assert alloc.match_prefix(tokens[:33], 16) == 2
+    assert alloc.match_prefix(tokens[:32], 16) == 1   # 1 token must
+    assert alloc.match_prefix(divergent, 16) == 1  # . . . prefill
+    assert alloc.match_prefix(tokens[:8], 16) == 0    # below minimum
+    assert alloc.adopt_prefix(1, tokens, 16) == 32
+    assert alloc.holds(1) == 2 and alloc.prefix_hits == 2
+    # donor release: indexed pages stay warm (index ref), the
+    # unregistered third page frees; adopter release drops to
+    # index-only; nothing leaks at any point.
+    assert alloc.release(0) == 3
+    assert alloc.match_prefix(tokens, 16) == 2
+    assert alloc.leaked_pages() == 0
+    assert alloc.release(1) == 2
+    assert alloc.match_prefix(tokens, 16) == 2   # still warm
+    assert alloc.leaked_pages() == 0
+    # pool pressure reclaims the index-only pages (leaf first) rather
+    # than failing the allocation.
+    assert alloc.ensure(2, 4)
+    assert alloc.ensure(0, 4)
+    assert alloc.match_prefix(tokens, 16) == 0   # index reclaimed
+    assert alloc.leaked_pages() == 0
+    alloc.reset()
+    assert alloc.free_pages == 8 and alloc.leaked_pages() == 0
+
+
 # -- the one-counted-fetch-per-block serving contract ----------------------
 
 
@@ -547,7 +796,13 @@ def test_llm_element_device_loop_end_to_end(runtime):
     assert stats["explicit_by_label"]["llm_block"] \
         == batcher.blocks_retired
     assert stats["implicit"] == 0
-    # Serving latency histograms reached the telemetry plane.
+    # Serving latency histograms reached the telemetry plane.  The
+    # worker publishes AFTER the tick that finishes the last request,
+    # racing the frame response this test just consumed -- wait for
+    # the publish instead of sampling once (flaky before).
+    from conftest import run_until
+    assert run_until(runtime,
+                     lambda: "llm_ttft_ms" in pipeline.metrics_text())
     metrics = pipeline.metrics_text()
     assert "llm_ttft_ms" in metrics
     assert "llm_tpot_ms" in metrics

@@ -35,6 +35,7 @@ DEFINITION_FIXTURES = {
     "placement_remote.json": "placement-remote",
     "bad_parameter.json": "bad-parameter",
     "bad_element_parameter.json": "bad-parameter",
+    "bad_prefix_cache.json": "bad-parameter",
     "bad_data_plane.json": "bad-parameter",
     "bad_qos.json": "bad-parameter",
     "bad_qos_tenant.json": "bad-parameter",
@@ -94,6 +95,27 @@ def test_element_parameter_domains_scoped_to_module():
         "LLM", {"speculative": "banana"}, "p: a",
         module="aiko_services_tpu/elements/llm.py")
     assert [f.rule for f in findings] == ["bad-parameter"]
+
+
+def test_prefix_cache_knob_domains():
+    """ISSUE 18 shared-prefix KV knobs validate at create time: each
+    bad value fires exactly one bad-parameter finding, and the full
+    good configuration (including ``speculative: auto``) is clean."""
+    from aiko_services_tpu.analysis.params import \
+        validate_element_parameters
+
+    module = "aiko_services_tpu.elements.llm"
+    assert validate_element_parameters(
+        "LLM", {"prefix_cache": "on", "prefix_min_tokens": 64,
+                "spec_autoprobe": "off", "speculative": "auto"},
+        "p: a", module=module) == []
+    for bad in ({"prefix_cache": "maybe"},
+                {"prefix_min_tokens": 0},
+                {"prefix_min_tokens": "lots"},
+                {"spec_autoprobe": "sometimes"}):
+        findings = validate_element_parameters(
+            "LLM", bad, "p: a", module=module)
+        assert [f.rule for f in findings] == ["bad-parameter"], bad
 
 
 def test_every_rule_has_a_fixture():
