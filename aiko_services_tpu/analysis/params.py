@@ -192,6 +192,28 @@ PIPELINE_PARAMETERS: dict[str, ParamSpec] = {
         "activity (frames/pongs) for this long frees its stream, "
         "window slots and QoS budget (0 = never reap)",
         number=True, minimum=0),
+    # -- fleet observability plane (ISSUE 19) --------------------------
+    "metrics_port": ParamSpec(
+        "telemetry HTTP endpoint, bound pre-registration and "
+        "advertised as the metrics= registrar tag the fleet "
+        "aggregator discovers (0 = kernel-assigned, echoed on "
+        "share.metrics_port)", number=True, minimum=0),
+    "metrics_host": ParamSpec(
+        "interface the metrics endpoint binds (default 127.0.0.1)"),
+    "fleet": ParamSpec(
+        "run the registrar-discovered fleet metrics/trace/SLO "
+        "aggregator in this process (mounted at the gateway's "
+        "/fleet* routes when the door is open)",
+        choices=("on", "off", "true", "false", "0", "1")),
+    "fleet_scrape_ms": ParamSpec(
+        "fleet aggregator sweep interval over member /metrics/raw "
+        "endpoints (0 = no background thread)",
+        number=True, minimum=0),
+    "slo": ParamSpec(
+        "per-tenant SLO objectives {class: {p99_ms, availability, "
+        "window_s}} (dict or JSON) -- attaches the error-budget burn "
+        "engine without a qos admission block (qos: {slo: ...} is the "
+        "usual home)", kind="json"),
 }
 
 
@@ -341,6 +363,14 @@ def _check_value(name: str, spec: ParamSpec, value, spot: str) \
         problem = qos_spec_error(value)
         if problem is not None:
             return Finding("bad-parameter", f"qos: {problem}", spot)
+    if spec.kind == "json" and name == "slo" and value is not None:
+        # Per-tenant SLO objectives (ISSUE 19): same jax-free twin the
+        # runtime uses (gateway/qos.py slo_spec_error) -- a malformed
+        # objective is a create-time finding, not a silent no-burn.
+        from ..gateway.qos import slo_spec_error
+        problem = slo_spec_error(value)
+        if problem is not None:
+            return Finding("bad-parameter", f"slo: {problem}", spot)
     return None
 
 

@@ -560,6 +560,64 @@ def loadgen(host, port, rate, overload, frames, deadline_ms, busy_ms):
         click.echo(json_module.dumps(report[0], indent=2))
 
 
+# -- fleet observability -----------------------------------------------------
+
+@main.command("fleet")
+@_transport_option
+@click.option("--member", "members", multiple=True,
+              help="static host:port scrape target (repeatable; "
+                   "additive with registrar discovery)")
+@click.option("--scrape-ms", default=None, type=float,
+              help="scrape cadence (default: 1000)")
+@click.option("--interval", default=2.0,
+              help="seconds between terminal renders")
+@click.option("--once", is_flag=True,
+              help="one scrape sweep, one render, exit")
+def fleet(transport, members, scrape_ms, interval, once):
+    """Run a standalone fleet collector: registrar-discovered members
+    (the ``metrics=`` / ``gateway=`` tags pipelines bind) plus any
+    ``--member`` targets, scraped at ``/metrics/raw``, merged exactly,
+    rendered as a terminal view.  jax-free -- runs anywhere."""
+    import threading
+    import time as time_module
+
+    from .observability.fleet import (FLEET_SCRAPE_MS_DEFAULT,
+                                      FleetCollector)
+
+    cadence = scrape_ms if scrape_ms is not None \
+        else FLEET_SCRAPE_MS_DEFAULT
+    if once and members:
+        # Static targets need no fabric at all: sweep, render, exit.
+        collector = FleetCollector(scrape_ms=0, members=members)
+        collector.scrape_once()
+        click.echo(collector.render_terminal())
+        return
+    runtime = _runtime(transport)
+    collector = FleetCollector(runtime=runtime, scrape_ms=cadence,
+                               members=members)
+    collector.start()
+
+    def render_loop():
+        try:
+            if once:
+                # Give discovery one beat to populate, then one sweep.
+                time_module.sleep(max(interval, 0.5))
+                collector.scrape_once()
+                click.echo(collector.render_terminal())
+                return
+            while True:
+                time_module.sleep(interval)
+                click.echo(collector.render_terminal())
+                click.echo("")
+        finally:
+            if once:
+                runtime.engine.terminate()
+
+    threading.Thread(target=render_loop, daemon=True,
+                     name="fleet-render").start()
+    runtime.run()
+
+
 # -- critical-path explain (offline) ----------------------------------------
 
 @main.command("explain")

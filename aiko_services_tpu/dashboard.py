@@ -26,12 +26,12 @@ import collections
 
 from .runtime import init_process
 from .services import (ECConsumer, REGISTRAR_PROTOCOL,
-                       SERVICE_PROTOCOL_PREFIX)
+                       SERVICE_PROTOCOL_PREFIX, ServiceTags)
 from .services.share import services_cache_singleton
 from .utils import generate, get_logger
 
 __all__ = ["DashboardModel", "run_dashboard", "ServicePlugin",
-           "register_plugin", "plugin_for"]
+           "FleetPlugin", "register_plugin", "plugin_for"]
 
 _logger = get_logger("aiko.dashboard")
 
@@ -134,6 +134,10 @@ class PipelinePlugin(ServicePlugin):
         if telemetry_lines:
             lines.append("[telemetry]")
             lines.extend(telemetry_lines)
+        fleet_lines = FleetPlugin.fleet_lines(record)
+        if fleet_lines:
+            lines.append("[fleet]")
+            lines.extend(fleet_lines)
         extras = [(name, value) for name, value in model.share_items()
                   if name.split(".")[0] not in
                   ("element_count", "streams", "frames_processed",
@@ -145,7 +149,73 @@ class PipelinePlugin(ServicePlugin):
         return lines
 
 
+class FleetPlugin(ServicePlugin):
+    """The fleet-aggregate view behind a pipeline that runs a
+    collector (``fleet: on``): scrapes the selected service's
+    ``/fleet`` + ``/fleet/slo`` over the endpoint its own registrar
+    tags advertise (``gateway=`` or ``metrics=``) and renders the
+    fleet-wide headline rows -- the aggregate samples carry no
+    ``pipeline`` label, which is how they are filtered here.  Share
+    dicts stay the transport for everything else; the fleet plane is
+    pull-based by design, so this plugin pulls."""
+
+    title = "fleet"
+    #: Headline series worth terminal space (full detail: GET /fleet).
+    SERIES = ("frame_latency_ms", "gateway_e2e_ms", "llm_ttft_ms")
+
+    @staticmethod
+    def _endpoint(record) -> str | None:
+        tags = getattr(record, "tags", None) or []
+        return ServiceTags.get(tags, "gateway") \
+            or ServiceTags.get(tags, "metrics")
+
+    @classmethod
+    def fleet_lines(cls, record, timeout: float = 1.0) -> list[str]:
+        """Aggregate rows + per-tenant burn, or [] when the service
+        exports no endpoint / no collector answers there."""
+        import json as json_module
+        import urllib.request
+
+        endpoint = cls._endpoint(record)
+        if endpoint is None:
+            return []
+        lines: list[str] = []
+        try:
+            with urllib.request.urlopen(f"http://{endpoint}/fleet",
+                                        timeout=timeout) as reply:
+                text = reply.read().decode()
+        except Exception:
+            return []
+        for line in text.splitlines():
+            if line.startswith("#") or "pipeline=" in line:
+                continue                    # fleet-aggregate rows only
+            if any(series in line for series in cls.SERIES) \
+                    or line.startswith("aiko_fleet_"):
+                lines.append(line)
+        try:
+            with urllib.request.urlopen(f"http://{endpoint}/fleet/slo",
+                                        timeout=timeout) as reply:
+                slo = json_module.loads(reply.read().decode())
+        except Exception:
+            return lines
+        for tenant, classes in (slo.get("tenants") or {}).items():
+            for cls_name, entry in classes.items():
+                burn = entry.get("burn") if isinstance(entry, dict) \
+                    else entry
+                if burn is None:
+                    continue
+                lines.append(f"slo burn {tenant}/{cls_name}: "
+                             f"{float(burn):.2f}x")
+        return lines
+
+    def render(self, model, record):
+        lines = self.fleet_lines(record)
+        return lines or ["no fleet collector reachable (fleet: on, "
+                         "plus a gateway= or metrics= endpoint)"]
+
+
 register_plugin(REGISTRAR_PROTOCOL, RegistrarPlugin)
+register_plugin("fleet", FleetPlugin)
 # Spelled out rather than importing PROTOCOL_PIPELINE: the pipeline
 # package pulls in jax, which a service browser doesn't need.  Equality
 # with the real constant is asserted in tests/test_dashboard_cli.py.

@@ -635,11 +635,21 @@ class LLM(PipelineElement):
         stats = batcher.take_request_stats()
         if telemetry is not None:
             for entry in stats:
+                # Tenant/class labels (ISSUE 19): the per-tenant SLO
+                # view needs decode latency split the same way the
+                # gateway splits e2e.  Unlabeled when the request
+                # carried no QoS context (direct element use).
+                labels = {}
+                if entry.get("tenant"):
+                    labels["tenant"] = str(entry["tenant"])
+                if entry.get("cls"):
+                    labels["cls"] = str(entry["cls"])
                 telemetry.registry.observe("llm_ttft_ms",
-                                           entry["ttft_ms"])
+                                           entry["ttft_ms"], **labels)
                 if entry["tokens"] > 1:
                     telemetry.registry.observe("llm_tpot_ms",
-                                               entry["tpot_ms"])
+                                               entry["tpot_ms"],
+                                               **labels)
         changed = False
         hits = batcher.prefix_hits
         lookups = batcher.prefix_lookups

@@ -52,8 +52,8 @@ import json
 import threading
 import time
 
-__all__ = ["QosScheduler", "TokenBucket", "QOS_CLASSES",
-           "DEFAULT_CLASS", "qos_spec_error"]
+__all__ = ["QosScheduler", "SloTracker", "TokenBucket", "QOS_CLASSES",
+           "DEFAULT_CLASS", "qos_spec_error", "slo_spec_error"]
 
 #: default priority classes, most to least urgent; ``classes`` in the
 #: ``qos`` block re-weights or extends them.
@@ -79,7 +79,13 @@ LAZY_TENANT_CAP = 1024
 _TENANT_KEYS = {"rate", "burst", "budget", "class"}
 _CLASS_KEYS = {"weight", "device_inflight"}
 _SPEC_KEYS = {"classes", "tenants", "default_tenant", "promote_ms",
-              "age_ms", "max_inflight", "session_window"}
+              "age_ms", "max_inflight", "session_window", "slo"}
+_SLO_KEYS = {"p99_ms", "availability", "window_s"}
+SLO_WINDOW_S_DEFAULT = 60.0
+#: debounce between fast-burn firings for one (tenant, class) -- the
+#: remediation consumer (ring event + black-box dump) must not be
+#: re-triggered every result while the burn persists.
+SLO_FIRE_COOLDOWN_S = 5.0
 
 
 class TokenBucket:
@@ -134,6 +140,53 @@ class _Tenant:
     @property
     def over_budget(self) -> bool:
         return self.budget > 0 and self.inflight > self.budget
+
+
+def slo_spec_error(value) -> str | None:
+    """Why an ``slo`` block is malformed, or None -- the jax-free
+    create-time twin of :class:`SloTracker` construction (same
+    discipline as :func:`qos_spec_error`): a typo'd objective is a
+    DefinitionError at create, even under ``preflight: off``.  Shape:
+    ``{class: {p99_ms: N, availability: 0..1, window_s: N}}``."""
+    if isinstance(value, str):
+        try:
+            value = json.loads(value)
+        except json.JSONDecodeError as error:
+            return f"unparseable JSON ({error})"
+    if not isinstance(value, dict):
+        return f"expected a dict, got {type(value).__name__}"
+    for name, spec in value.items():
+        if not isinstance(spec, dict):
+            return f"{name} must be a dict of objectives"
+        bad = set(spec) - _SLO_KEYS
+        if bad:
+            return f"{name}: unknown keys {sorted(bad)} (one of " \
+                   f"{sorted(_SLO_KEYS)})"
+        if not (set(spec) & {"p99_ms", "availability"}):
+            return f"{name}: declare p99_ms and/or availability"
+        if "p99_ms" in spec:
+            try:
+                if float(spec["p99_ms"]) <= 0:
+                    return f"{name}.p99_ms must be > 0"
+            except (TypeError, ValueError):
+                return f"{name}.p99_ms={spec['p99_ms']!r} is not a number"
+        if "availability" in spec:
+            try:
+                availability = float(spec["availability"])
+            except (TypeError, ValueError):
+                return f"{name}.availability=" \
+                       f"{spec['availability']!r} is not a number"
+            if not 0.0 < availability < 1.0:
+                return f"{name}.availability must be in (0, 1) " \
+                       f"(1.0 leaves a zero error budget)"
+        if "window_s" in spec:
+            try:
+                if float(spec["window_s"]) <= 0:
+                    return f"{name}.window_s must be > 0"
+            except (TypeError, ValueError):
+                return f"{name}.window_s={spec['window_s']!r} is " \
+                       f"not a number"
+    return None
 
 
 def qos_spec_error(value) -> str | None:
@@ -210,7 +263,152 @@ def qos_spec_error(value) -> str | None:
                     return f"{key} must be >= {minimum}"
             except (TypeError, ValueError):
                 return f"{key}={value[key]!r} is not a number"
+    if "slo" in value:
+        problem = slo_spec_error(value["slo"])
+        if problem is not None:
+            return f"slo: {problem}"
+        for name in value["slo"]:
+            if str(name) not in known:
+                return f"slo.{name}: not a declared class (one of " \
+                       f"{sorted(known)})"
     return None
+
+
+class SloTracker:
+    """Windowed per-tenant/class error-budget burn rates from declared
+    objectives (``slo: {class: {p99_ms, availability}}`` in the qos
+    block).  Burn rate = (observed bad fraction) / (budgeted bad
+    fraction): > 1 means the error budget is being spent faster than
+    the objective allows (Vortex, PAPERS.md: per-class SLO tracking at
+    the front door).  The gateway feeds it one observation per
+    delivered result (+ one per front-door reject); everything here is
+    jax-free, bounded, and thread-safe (gateway pump + HTTP threads).
+
+    - latency burn: fraction of windowed samples over ``p99_ms``,
+      against the 1% budget a p99 target implies.
+    - availability burn: fraction of windowed samples that failed
+      (error results, sheds, rejects, deadline misses), against the
+      ``1 - availability`` budget.
+    - overall burn = max of the declared ones.
+    """
+
+    def __init__(self, spec: dict | str | None):
+        if isinstance(spec, str):
+            spec = json.loads(spec) if spec else {}
+        spec = dict(spec or {})
+        problem = slo_spec_error(spec)
+        if problem is not None:
+            raise ValueError(f"slo: {problem}")
+        self.objectives: dict[str, dict] = {}
+        for name, entry in spec.items():
+            self.objectives[str(name)] = {
+                "p99_ms": (None if "p99_ms" not in entry
+                           else float(entry["p99_ms"])),
+                "availability": (None if "availability" not in entry
+                                 else float(entry["availability"])),
+                "window_s": float(entry.get("window_s",
+                                            SLO_WINDOW_S_DEFAULT))}
+        self._lock = threading.Lock()
+        #: (tenant, cls) -> list of (monotonic stamp, e2e_ms|None, ok)
+        self._samples: dict[tuple, list] = {}
+        self._fired_at: dict[tuple, float] = {}
+        self.fired = 0
+
+    def tracks(self, qos_class: str | None) -> bool:
+        return str(qos_class or DEFAULT_CLASS) in self.objectives
+
+    def _window(self, qos_class: str) -> float:
+        entry = self.objectives.get(qos_class)
+        return SLO_WINDOW_S_DEFAULT if entry is None \
+            else entry["window_s"]
+
+    def observe(self, tenant: str | None, qos_class: str | None,
+                e2e_ms: float | None, ok: bool,
+                now: float | None = None) -> None:
+        """One delivered result (``e2e_ms`` door-to-door) or one
+        latency-less bad event (reject/shed: ``e2e_ms=None``,
+        ``ok=False``)."""
+        qos_class = str(qos_class or DEFAULT_CLASS)
+        if qos_class not in self.objectives:
+            return
+        now = time.monotonic() if now is None else now
+        key = (str(tenant or DEFAULT_TENANT), qos_class)
+        horizon = now - self._window(qos_class)
+        with self._lock:
+            samples = self._samples.setdefault(key, [])
+            samples.append((now, e2e_ms, bool(ok)))
+            while samples and samples[0][0] < horizon:
+                samples.pop(0)
+
+    def _burn_locked(self, key: tuple, now: float) -> dict | None:
+        tenant, qos_class = key
+        objective = self.objectives[qos_class]
+        horizon = now - objective["window_s"]
+        samples = [entry for entry in self._samples.get(key, ())
+                   if entry[0] >= horizon]
+        if not samples:
+            return None
+        result = {"tenant": tenant, "cls": qos_class,
+                  "samples": len(samples),
+                  "window_s": objective["window_s"], "burn": 0.0}
+        p99_ms = objective["p99_ms"]
+        if p99_ms is not None:
+            timed = [entry for entry in samples
+                     if entry[1] is not None]
+            over = sum(1 for entry in timed if entry[1] > p99_ms
+                       or not entry[2])
+            result["p99_ms_target"] = p99_ms
+            result["latency_burn"] = round(
+                (over / len(timed)) / 0.01, 3) if timed else 0.0
+            result["burn"] = max(result["burn"],
+                                 result["latency_burn"])
+        availability = objective["availability"]
+        if availability is not None:
+            bad = sum(1 for entry in samples if not entry[2])
+            result["availability_target"] = availability
+            result["availability_burn"] = round(
+                (bad / len(samples)) / (1.0 - availability), 3)
+            result["burn"] = max(result["burn"],
+                                 result["availability_burn"])
+        result["burn"] = round(result["burn"], 3)
+        return result
+
+    def burn_rates(self, now: float | None = None) -> dict:
+        """{tenant: {cls: burn report}} over each class's window."""
+        now = time.monotonic() if now is None else now
+        report: dict = {}
+        with self._lock:
+            for key in list(self._samples):
+                entry = self._burn_locked(key, now)
+                if entry is not None:
+                    report.setdefault(key[0], {})[key[1]] = entry
+        return report
+
+    def fast_burns(self, now: float | None = None) -> list:
+        """Newly-firing (tenant, cls, burn) triples with burn > 1,
+        debounced :data:`SLO_FIRE_COOLDOWN_S` per key -- the
+        remediation trigger (ring event + black-box dump; ROADMAP
+        item 4's controller subscribes to exactly this)."""
+        now = time.monotonic() if now is None else now
+        fired = []
+        with self._lock:
+            for key in list(self._samples):
+                entry = self._burn_locked(key, now)
+                if entry is None or entry["burn"] <= 1.0:
+                    continue
+                last = self._fired_at.get(key, -1e9)
+                if now - last < SLO_FIRE_COOLDOWN_S:
+                    continue
+                self._fired_at[key] = now
+                self.fired += 1
+                fired.append((key[0], key[1], entry["burn"]))
+        return fired
+
+    def snapshot(self, now: float | None = None) -> dict:
+        return {"objectives": {name: dict(entry) for name, entry
+                               in self.objectives.items()},
+                "fired": self.fired,
+                "tenants": self.burn_rates(now)}
 
 
 class QosScheduler:
@@ -260,6 +458,9 @@ class QosScheduler:
         self._seq = 0
         self.promotions = 0
         self.inflight_total = 0
+        #: declared objectives -> burn-rate tracker (None without an
+        #: ``slo`` block); the gateway feeds it per delivered result.
+        self.slo = SloTracker(spec["slo"]) if spec.get("slo") else None
 
     # -- resolution --------------------------------------------------------
 
@@ -433,8 +634,10 @@ class QosScheduler:
     # -- reporting ---------------------------------------------------------
 
     def stats(self) -> dict:
+        slo = None if self.slo is None else self.slo.snapshot()
         with self._lock:
             return {
+                "slo": slo,
                 "classes": {name: rank for name, rank
                             in self.class_ranks.items()},
                 "promote_ms": self.promote_ms,

@@ -64,6 +64,9 @@ import uuid
 
 from . import ws
 from .qos import QosScheduler
+from ..observability.metrics import MetricsRegistry
+from ..observability.tracing import (TraceBuffer, decode_spans,
+                                     make_span, mint_id)
 from ..utils import get_logger, generate, parse, parse_number
 
 __all__ = ["GatewayServer", "json_safe", "decode_data"]
@@ -186,6 +189,11 @@ class _Session:
         self.frame_seq = 0
         self.last_delivered = -1
         self.last_activity = time.monotonic()
+        # Door-to-decode tracing: frame_id -> (trace_id, root span id,
+        # wall start, monotonic start, admission-wait ms).  Bounded by
+        # the session window (only admitted frames enter); the pump
+        # pops each entry when its result is delivered (or deduped).
+        self.trace_pending: dict[int, tuple] = {}
 
     def next_frame_id(self) -> int:
         with self.state_lock:
@@ -268,6 +276,17 @@ class GatewayServer:
         self._pending_failovers: list[tuple] = []
         self.failovers = 0
         self.sessions_reaped = 0
+        # Observability plane (ISSUE 19): a standalone gateway owns its
+        # registry + trace buffer; with a pipeline in-process both
+        # delegate to its telemetry so gateway spans and pipeline spans
+        # land in ONE buffer (TraceBuffer.add merges by trace_id).
+        self._own_registry: MetricsRegistry | None = None
+        self._own_traces: TraceBuffer | None = None
+        #: fleet aggregator serving /fleet* when attached (the owning
+        #: pipeline wires one under ``fleet: on``, or the operator sets
+        #: it on a standalone gateway).
+        self.fleet = None
+        self._slo_gauge_stamp = 0.0
         # Idle-session reaping (``session_idle_ms``; 0 = off).
         self.session_idle_ms = max(0.0, float(session_idle_ms or 0.0))
         self._reaper: threading.Thread | None = None
@@ -448,27 +467,39 @@ class GatewayServer:
             generate("create_stream", [stream_id, dict(parameters)]))
 
     def _send_wire_frame(self, target: str, stream_id: str,
-                         frame_id: int, data: dict) -> None:
+                         frame_id: int, data: dict,
+                         trace_id: str | None = None,
+                         trace_parent: str | None = None) -> None:
         from ..pipeline.codec import encode_frame_data
         header = {"stream_id": stream_id, "frame_id": int(frame_id),
                   "response_topic": self._response_topic}
+        if trace_id:
+            # Door-to-decode: the remote pipeline stamps its spans
+            # under the gateway's root and returns them in the
+            # response header (the PR 4 remote-hop machinery).
+            header["trace_id"] = trace_id
+            header["trace_parent"] = trace_parent
         self.runtime.message.publish(
             f"{target}/in",
             generate("process_frame",
                      [header, encode_frame_data(data)]))
 
     def _dispatch_frame(self, session: _Session, data: dict,
-                        frame_id: int) -> None:
+                        frame_id: int, trace: tuple | None = None) -> None:
         """Route one admitted frame to the session's current target.
         Every frame carries the session-owned id, so delivery dedupe
         holds across failovers regardless of which pipeline answers."""
+        trace_id = trace[0] if trace else None
+        trace_parent = trace[1] if trace else None
         if session.target is None and self.pipeline is not None:
             self.pipeline.process_frame_local(
                 data, stream_id=session.stream_id,
-                queue_response=session.queue, frame_id=frame_id)
+                queue_response=session.queue, frame_id=frame_id,
+                trace_id=trace_id, trace_parent=trace_parent)
         elif session.target:
             self._send_wire_frame(session.target, session.stream_id,
-                                  frame_id, data)
+                                  frame_id, data, trace_id=trace_id,
+                                  trace_parent=trace_parent)
         else:
             _logger.warning("gateway: session %s has no live target; "
                             "frame %d dropped at the door",
@@ -495,6 +526,16 @@ class GatewayServer:
             decoded, okay = {}, False
             header.setdefault("diagnostic",
                               f"undecodable result ({error})")
+        spans_text = header.get("spans")
+        if spans_text:
+            # The wire-bound pipeline's spans for this frame (it saw
+            # our trace_id, so it returned them instead of keeping a
+            # private trace): merge them under the gateway's trace.
+            spans = decode_spans(spans_text)
+            if spans:
+                traces = self._traces()
+                if traces is not None:
+                    traces.add(spans[0].get("trace_id"), spans, okay)
         entry = (stream_id,
                  None if frame_id is None else int(frame_id),
                  decoded, {}, okay,
@@ -563,6 +604,111 @@ class GatewayServer:
         if self._default_qos is None:
             self._default_qos = QosScheduler()
         return self._default_qos
+
+    # -- observability plane (ISSUE 19) ------------------------------------
+
+    def _registry(self) -> "MetricsRegistry | None":
+        """The metrics registry gateway series land in: the pipeline's
+        (one process, one registry) or the gateway's own when
+        standalone.  None when the pipeline disabled telemetry -- the
+        door honors ``telemetry: off`` like every other plane."""
+        if self.pipeline is not None:
+            telemetry = getattr(self.pipeline, "telemetry", None)
+            return None if telemetry is None else telemetry.registry
+        if self._own_registry is None:
+            self._own_registry = MetricsRegistry()
+        return self._own_registry
+
+    def _traces(self) -> "TraceBuffer | None":
+        """Trace buffer, same ownership rule as :meth:`_registry`."""
+        if self.pipeline is not None:
+            telemetry = getattr(self.pipeline, "telemetry", None)
+            return None if telemetry is None else telemetry.traces
+        if self._own_traces is None:
+            self._own_traces = TraceBuffer()
+        return self._own_traces
+
+    def _mint_trace(self, session: "_Session | None", frame_id: int,
+                    admit_ms: float) -> "tuple | None":
+        """Root a new door-to-decode trace for one admitted frame:
+        (trace_id, root span id, wall start, monotonic start,
+        admission-wait ms).  The dispatched frame carries trace_id +
+        the root as its parent, so every downstream span -- origin
+        pipeline, remote hops, LLM decode blocks -- joins THIS trace."""
+        if self._traces() is None:
+            return None
+        entry = (mint_id(), mint_id(), time.time(), time.monotonic(),
+                 admit_ms)
+        if session is not None:
+            session.trace_pending[frame_id] = entry
+        return entry
+
+    def _finish_trace(self, session: "_Session | None", entry: tuple,
+                      frame_id, stream_id: str, okay: bool,
+                      pump_start: float, extra_spans=None) -> str:
+        """Close the gateway's spans (root session span = door-to-door
+        e2e, admission wait, result pump) and merge them into the
+        buffer under the frame's trace_id."""
+        trace_id, root, wall_start, mono_start, admit_ms = entry
+        now = time.monotonic()
+        spans = [make_span(trace_id, root, None,
+                           f"gateway:{self.name}", "gateway",
+                           process=self.name, stream=stream_id,
+                           frame=frame_id, start=wall_start,
+                           duration_ms=(now - mono_start) * 1000.0,
+                           status="ok" if okay else "error"),
+                 make_span(trace_id, mint_id(), root, "gateway:admit",
+                           "gateway", process=self.name,
+                           stream=stream_id, frame=frame_id,
+                           start=wall_start, duration_ms=admit_ms),
+                 make_span(trace_id, mint_id(), root, "gateway:pump",
+                           "gateway", process=self.name,
+                           stream=stream_id, frame=frame_id,
+                           start=wall_start
+                           + (pump_start - mono_start),
+                           duration_ms=(now - pump_start) * 1000.0)]
+        if extra_spans:
+            spans.extend(extra_spans)
+        traces = self._traces()
+        if traces is not None:
+            traces.add(trace_id, spans, okay)
+        return trace_id
+
+    def _note_slo(self, tenant: str, qos_class: str,
+                  e2e_ms: "float | None", okay: bool) -> None:
+        """One SLO observation (delivered result or latency-less bad
+        event), plus the fast-burn check: a burn > 1 fires the
+        remediation pair (ring event + debounced black-box dump, via
+        the pipeline's event loop) and is counted.  Burn gauges
+        refresh at most once a second."""
+        slo = self.qos.slo
+        if slo is None:
+            return
+        label = self.qos.tenant(tenant).name
+        slo.observe(label, qos_class, e2e_ms, okay)
+        registry = self._registry()
+        fired = slo.fast_burns()
+        for burn_tenant, burn_class, burn in fired:
+            if registry is not None:
+                registry.count("slo_fast_burns", tenant=burn_tenant,
+                               cls=burn_class)
+            _logger.warning(
+                "gateway: SLO fast burn %.2fx (tenant %s, class %s)",
+                burn, burn_tenant, burn_class)
+        now = time.monotonic()
+        burns = None
+        if fired or now - self._slo_gauge_stamp >= 1.0:
+            self._slo_gauge_stamp = now
+            burns = slo.burn_rates(now)
+            if registry is not None:
+                for tenant_name, classes in burns.items():
+                    for class_name, entry in classes.items():
+                        registry.gauge("slo_burn", entry["burn"],
+                                       tenant=tenant_name,
+                                       cls=class_name)
+        if self.pipeline is not None and (fired or burns is not None):
+            self.pipeline.post_self("note_slo_burn",
+                                    [list(fired), burns])
 
     # -- plumbing ----------------------------------------------------------
 
@@ -684,6 +830,12 @@ class GatewayServer:
                 "failovers": self.failovers,
                 "sessions_reaped": self.sessions_reaped})
             return
+        if method == "GET" and (path in ("/metrics", "/metrics/raw",
+                                         "/slo")
+                                or path.startswith("/traces")
+                                or path.startswith("/fleet")):
+            self._serve_observability(conn, path.rstrip("/") or "/")
+            return
         if method == "POST" and path == "/v1/frames":
             length = int(headers.get("content-length", "0"))
             body = body_start
@@ -719,11 +871,17 @@ class GatewayServer:
             self._http_reply(conn, 400, {"error": "bad data",
                                          "detail": str(error)[:200]})
             return
+        admit_start = time.monotonic()
         admitted, reason = self._admit(tenant, qos_class, None)
+        admit_ms = (time.monotonic() - admit_start) * 1000.0
         if not admitted:
+            self._note_slo(tenant, qos_class, None, False)
             self._http_reply(conn, 429, {"error": "rejected",
                                          "reason": reason})
             return
+        registry = self._registry()
+        if registry is not None:
+            registry.observe("gateway_admit_wait_ms", admit_ms)
         with self._sessions_lock:
             self._http_seq += 1
             stream_id = f"gwhttp/{self.port}/{self._http_seq}"
@@ -742,6 +900,9 @@ class GatewayServer:
         if target == "":
             self._http_reply(conn, 503, {"error": "no backend"})
             return
+        trace = self._mint_trace(None, 0, admit_ms)
+        trace_id = None if trace is None else trace[0]
+        trace_parent = None if trace is None else trace[1]
         if target is None:
             # Mailbox FIFO: the create lands before the ingest, so the
             # frame sees the session's tenant/class/deadline parameters.
@@ -749,15 +910,20 @@ class GatewayServer:
                                [stream_id, parameters, None, 0,
                                 responses])
             pipeline.process_frame_local(data, stream_id=stream_id,
-                                         queue_response=responses)
+                                         queue_response=responses,
+                                         trace_id=trace_id,
+                                         trace_parent=trace_parent)
         else:
             self._http_waits[stream_id] = responses
             self._create_wire_stream(target, stream_id, parameters)
-            self._send_wire_frame(target, stream_id, 0, data)
+            self._send_wire_frame(target, stream_id, 0, data,
+                                  trace_id=trace_id,
+                                  trace_parent=trace_parent)
         try:
             (_, frame_id, swag, metrics, okay, diagnostic) = \
                 responses.get(timeout=_HTTP_TIMEOUT_S)
         except Exception:
+            self._note_slo(tenant, qos_class, None, False)
             self._http_reply(conn, 504, {"error": "timed out"})
             return
         finally:
@@ -768,16 +934,89 @@ class GatewayServer:
                 self.runtime.message.publish(
                     f"{target}/in",
                     generate("destroy_stream", [stream_id, True]))
+        pump_start = time.monotonic()
         bare = {key: value for key, value in swag.items()
                 if "." not in key}
         if pipeline is not None:
             bare = pipeline.transfer_ledger.fetch(bare)
+        e2e_ms = (time.monotonic() - trace[3]) * 1000.0 \
+            if trace is not None \
+            else float(metrics.get("time_pipeline", 0.0)) * 1000.0
+        self._note_slo(tenant, qos_class, e2e_ms, okay)
         status = 200 if okay else 503
-        self._http_reply(conn, status, {
+        reply = {
             "ok": bool(okay), "frame": frame_id,
             "data": json_safe(bare), "diagnostic": diagnostic,
             "e2e_ms": round(float(metrics.get("time_pipeline", 0.0))
-                            * 1000.0, 3)})
+                            * 1000.0, 3)}
+        if trace is not None:
+            reply["trace"] = self._finish_trace(
+                None, trace, frame_id, stream_id, okay, pump_start)
+        self._http_reply(conn, status, reply)
+
+    def _serve_observability(self, conn, path: str) -> None:
+        """The door's observability surface (ISSUE 19): the same
+        /metrics, /metrics/raw and /traces shapes as the pipeline's
+        MetricsServer (scraping a gateway and scraping a pipeline are
+        the same act), /slo for the live burn snapshot, and /fleet*
+        when a fleet aggregator is attached."""
+        if path.startswith("/fleet"):
+            fleet = self.fleet
+            if fleet is None:
+                self._http_reply(conn, 404, {
+                    "error": "no fleet collector attached "
+                             "(fleet: on)"})
+            elif path == "/fleet":
+                self._http_text_reply(conn, fleet.render_fleet_text())
+            elif path == "/fleet/slo":
+                self._http_reply(conn, 200, fleet.fleet_slo())
+            elif path.startswith("/fleet/traces/"):
+                trace = fleet.fleet_trace(
+                    path[len("/fleet/traces/"):])
+                if trace is None:
+                    self._http_reply(conn, 404,
+                                     {"error": "unknown trace"})
+                else:
+                    self._http_reply(conn, 200, trace)
+            else:
+                self._http_reply(conn, 404, {
+                    "error": "try /fleet, /fleet/slo or "
+                             "/fleet/traces/<id>"})
+            return
+        if path == "/slo":
+            slo = self.qos.slo
+            self._http_reply(conn, 200, {} if slo is None
+                             else slo.snapshot())
+            return
+        registry = self._registry()
+        if registry is None:
+            self._http_reply(conn, 404, {"error": "telemetry disabled"})
+            return
+        if path == "/metrics":
+            if self.pipeline is not None:
+                text = self.pipeline.telemetry.metrics_text()
+            else:
+                text = registry.render_text()
+            self._http_text_reply(conn, text)
+        elif path == "/metrics/raw":
+            if self.pipeline is not None:
+                self.pipeline.telemetry.metrics_text()   # gauge refresh
+            payload = registry.state()
+            payload["pipeline"] = self.name \
+                if self.pipeline is None else self.pipeline.name
+            self._http_reply(conn, 200, payload)
+        elif path == "/traces":
+            traces = self._traces()
+            self._http_reply(conn, 200, {"traces": traces.recent(50)})
+        elif path.startswith("/traces/"):
+            trace = self._traces().get(path[len("/traces/"):])
+            if trace is None:
+                self._http_reply(conn, 404, {"error": "unknown trace"})
+            else:
+                self._http_reply(conn, 200, trace)
+        else:
+            self._http_reply(conn, 404, {
+                "error": "try /metrics, /metrics/raw, /traces or /slo"})
 
     @staticmethod
     def _http_reply(conn, status: int, payload: dict) -> None:
@@ -787,6 +1026,15 @@ class GatewayServer:
                   504: "Gateway Timeout"}.get(status, "OK")
         conn.sendall((f"HTTP/1.1 {status} {reason}\r\n"
                       "Content-Type: application/json\r\n"
+                      f"Content-Length: {len(body)}\r\n"
+                      "Connection: close\r\n\r\n").encode() + body)
+
+    @staticmethod
+    def _http_text_reply(conn, text: str) -> None:
+        body = text.encode()
+        conn.sendall(("HTTP/1.1 200 OK\r\n"
+                      "Content-Type: text/plain; version=0.0.4; "
+                      "charset=utf-8\r\n"
                       f"Content-Length: {len(body)}\r\n"
                       "Connection: close\r\n\r\n").encode() + body)
 
@@ -974,9 +1222,17 @@ class GatewayServer:
                                     "reason": "bad-data",
                                     "error": str(error)[:200]})
             return
+        admit_start = time.monotonic()
         admitted, reason = self._admit(session.tenant,
                                        session.qos_class, session)
+        admit_ms = (time.monotonic() - admit_start) * 1000.0
         if not admitted:
+            if reason != "window":
+                # A rate reject is an availability event against the
+                # tenant's error budget; window backpressure is the
+                # client's own pipelining, not a served failure.
+                self._note_slo(session.tenant, session.qos_class,
+                               None, False)
             payload = {"op": "busy" if reason == "window"
                        else "rejected",
                        "reason": reason, "inflight": session.inflight}
@@ -985,7 +1241,12 @@ class GatewayServer:
                 payload["tag"] = tag
             self._ws_send(session, payload)
             return
-        self._dispatch_frame(session, data, session.next_frame_id())
+        registry = self._registry()
+        if registry is not None:
+            registry.observe("gateway_admit_wait_ms", admit_ms)
+        frame_id = session.next_frame_id()
+        trace = self._mint_trace(session, frame_id, admit_ms)
+        self._dispatch_frame(session, data, frame_id, trace=trace)
 
     def _ws_close(self, conn, session: _Session | None) -> None:
         # Only the session's CURRENT connection may destroy it: a
@@ -1031,9 +1292,11 @@ class GatewayServer:
                         # done record raced the crash) and the
                         # adopter replayed it anyway -- the client
                         # must see each id exactly once, in order.
+                        session.trace_pending.pop(frame_seq, None)
                         continue
                     session.last_delivered = frame_seq
             e2e_s = session.finish_slot()
+            pump_start = time.monotonic()
             bare = {key: value for key, value in swag.items()
                     if "." not in key}
             if pipeline is not None:
@@ -1042,15 +1305,29 @@ class GatewayServer:
                 except Exception as error:
                     okay, diagnostic = False, f"result fetch: {error}"
                     bare = {}
-            telemetry = getattr(pipeline, "telemetry", None)
-            if telemetry is not None:
-                telemetry.registry.observe("gateway_e2e_ms",
-                                           e2e_s * 1000.0,
-                                           cls=session.qos_class)
-            self._ws_send(session, {
+            registry = self._registry()
+            if registry is not None:
+                registry.observe("gateway_e2e_ms", e2e_s * 1000.0,
+                                 cls=session.qos_class,
+                                 tenant=self.qos.tenant(
+                                     session.tenant).name)
+            self._note_slo(session.tenant, session.qos_class,
+                           e2e_s * 1000.0, okay)
+            pending = None if frame_seq is None else \
+                session.trace_pending.pop(frame_seq, None)
+            payload = {
                 "op": "result", "frame": frame_id, "ok": bool(okay),
                 "data": json_safe(bare), "diagnostic": diagnostic,
-                "e2e_ms": round(e2e_s * 1000.0, 3)})
+                "e2e_ms": round(e2e_s * 1000.0, 3)}
+            if pending is not None:
+                payload["trace"] = pending[0]
+                # Finish BEFORE the send: once the client holds a
+                # result naming this trace id, /traces/<id> must
+                # resolve it (the pump span ends at hand-off to the
+                # socket, not after the write).
+                self._finish_trace(session, pending, frame_seq,
+                                   session.stream_id, okay, pump_start)
+            self._ws_send(session, payload)
 
     def _ws_send(self, session: _Session, payload: dict) -> None:
         with session.send_lock:

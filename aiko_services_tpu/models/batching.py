@@ -1248,7 +1248,9 @@ class ContinuousBatcher:
                 self._request_stats.append(
                     {"ttft_ms": round(ttft_ms, 3),
                      "tpot_ms": round(tpot_ms, 3),
-                     "tokens": request.generated})
+                     "tokens": request.generated,
+                     "tenant": request.tenant,
+                     "cls": request.qos_class})
             self._free_slot(request.slot)
 
     def _free_slot(self, slot: int):

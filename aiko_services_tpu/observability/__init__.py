@@ -25,9 +25,12 @@ from .critical_path import (BUCKETS, aggregate_traces, attribute_events,
                             render_timeline)
 from .telemetry import TELEMETRY_INTERVAL_DEFAULT, PipelineTelemetry
 from .exporter import MetricsServer
+from .fleet import FLEET_SCRAPE_MS_DEFAULT, FleetCollector
 
 __all__ = ["LogHistogram", "MetricsRegistry", "TraceBuffer",
-           "PipelineTelemetry", "MetricsServer", "make_span", "mint_id",
+           "PipelineTelemetry", "MetricsServer", "FleetCollector",
+           "FLEET_SCRAPE_MS_DEFAULT",
+           "make_span", "mint_id",
            "encode_spans", "decode_spans", "HISTOGRAM_WINDOW_DEFAULT",
            "TRACE_CAPACITY_DEFAULT", "TELEMETRY_INTERVAL_DEFAULT",
            "FlightRecorder", "events_as_dicts", "select_frame_events",

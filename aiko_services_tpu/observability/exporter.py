@@ -82,6 +82,19 @@ class MetricsServer:
             self._reply(handler, body,
                         "text/plain; version=0.0.4; charset=utf-8")
             return
+        if path == "/metrics/raw":
+            # The fleet aggregator's scrape format: exact histogram
+            # bucket counts (the text exposition only carries
+            # quantiles, which cannot be merged across processes).
+            if telemetry is None:
+                handler.send_error(404, "telemetry disabled")
+                return
+            telemetry.metrics_text()      # refresh the gauge snapshot
+            payload = telemetry.registry.state()
+            payload["pipeline"] = getattr(self.pipeline, "name", "?")
+            self._reply(handler, json.dumps(payload).encode(),
+                        "application/json")
+            return
         if path == "/traces" or path.startswith("/traces/"):
             if telemetry is None:
                 handler.send_error(404, "telemetry disabled")
@@ -118,7 +131,18 @@ class MetricsServer:
             query = parse_qs(parsed.query)
             try:
                 frame = query.get("frame")
-                if frame is not None:
+                trace = query.get("trace")
+                if trace is not None:
+                    # A gateway-minted trace id names the request end
+                    # to end; explain_frame resolves it to the frame
+                    # its spans carry.
+                    payload = self.pipeline.explain_frame(
+                        str(trace[0]),
+                        stream_id=query.get("stream", [None])[0])
+                    if payload is None:
+                        handler.send_error(404, "unknown trace")
+                        return
+                elif frame is not None:
                     payload = self.pipeline.explain_frame(
                         int(frame[0]),
                         stream_id=query.get("stream", [None])[0])

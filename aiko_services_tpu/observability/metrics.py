@@ -128,6 +128,31 @@ class LogHistogram:
                 return value
         return self.vmax
 
+    def state(self) -> dict:
+        """Raw cumulative state: the exact-merge substrate the fleet
+        aggregator scrapes (``/metrics/raw``).  Every LogHistogram in
+        the fleet shares the same fixed bucket edges, so cross-process
+        merge is element-wise addition -- no quantile sketch error on
+        top of the bucketing error."""
+        return {"counts": list(self.counts), "count": self.count,
+                "total": self.total, "vmin": self.vmin,
+                "vmax": self.vmax}
+
+    def merge_state(self, state: dict) -> None:
+        """Fold one scraped :meth:`state` in (addition; same edges)."""
+        counts = state.get("counts") or []
+        for index in range(min(len(counts), _BUCKETS)):
+            self.counts[index] += int(counts[index])
+        self.count += int(state.get("count", 0))
+        self.total += float(state.get("total", 0.0))
+        for name, pick in (("vmin", min), ("vmax", max)):
+            theirs = state.get(name)
+            if theirs is None:
+                continue
+            ours = getattr(self, name)
+            setattr(self, name, float(theirs) if ours is None
+                    else pick(ours, float(theirs)))
+
     def summary(self, windowed: bool = True) -> dict:
         return {"count": self.count,
                 "sum_ms": round(self.total, 3),
@@ -236,6 +261,30 @@ class MetricsRegistry:
         with self._lock:
             return [(name, dict(labels), value)
                     for (name, labels), value in self._gauges.items()]
+
+    def state(self) -> dict:
+        """JSON-able raw dump of every series (``/metrics/raw``): the
+        fleet aggregator's scrape format.  Histograms ship their exact
+        bucket counts (text exposition only carries quantiles, which
+        cannot be merged); counters/gauges ship as-is."""
+        with self._lock:
+            return {
+                "histograms": [
+                    {"name": name, "labels": dict(labels),
+                     **histogram.state()}
+                    for (name, labels), histogram
+                    in self._histograms.items()],
+                "counters": [
+                    {"name": name, "labels": dict(labels),
+                     "value": value}
+                    for (name, labels), value
+                    in self._counters.items()],
+                "gauges": [
+                    {"name": name, "labels": dict(labels),
+                     "value": value}
+                    for (name, labels), value
+                    in self._gauges.items()
+                    if isinstance(value, (int, float))]}
 
     # -- exposition --------------------------------------------------------
 

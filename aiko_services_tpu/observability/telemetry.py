@@ -309,8 +309,15 @@ class PipelineTelemetry:
                 # first token and per-output-token rate, fed by the
                 # serving element's batcher; rides share as
                 # telemetry.llm.* next to the llm_accepted_tokens /
-                # llm_draft_tokens counters below.
-                result.setdefault("llm", {})[name[4:]] = brief
+                # llm_draft_tokens counters below.  Tenant/class labels
+                # (ISSUE 19) key as ttft.<tenant>.<cls> so two labeled
+                # series never overwrite one dict slot.
+                key = name[4:]
+                if labels:
+                    key += "." + ".".join(
+                        str(labels[label])
+                        for label in sorted(labels))
+                result.setdefault("llm", {})[key] = brief
                 continue
             if name.startswith("frame_") and name.endswith("_ms") \
                     and name != "frame_latency_ms":
@@ -321,9 +328,16 @@ class PipelineTelemetry:
             if name == "gateway_e2e_ms":
                 # Gateway front door (ISSUE 12): per-class session
                 # latency -- telemetry.gateway.* on the dashboard,
-                # the live per-class SLO view.
+                # the live per-class SLO view.  With the tenant label
+                # (ISSUE 19) the per-class key keeps the LAST tenant's
+                # brief (dashboard headline); the exact per-tenant
+                # split rides gateway_tenants.<tenant>.<cls>.
                 result.setdefault("gateway", {})[
                     labels.get("cls", "?")] = brief
+                if labels.get("tenant"):
+                    result.setdefault("gateway_tenants", {}) \
+                        .setdefault(labels["tenant"], {})[
+                        labels.get("cls", "?")] = brief
                 continue
             if name == "frame_latency_ms":
                 result["frame"] = brief

@@ -303,7 +303,7 @@ class StreamJournal:
                              "topic": topic_response})
 
     def frame_ingested(self, stream_id: str, frame_id: int,
-                       swag: dict) -> int:
+                       swag: dict, trace_id=None) -> int:
         stream_id = str(stream_id)
         data, partial = self._encode_swag(swag)
         if partial:
@@ -311,13 +311,20 @@ class StreamJournal:
         with self._lock:
             entry = self._live.get(stream_id)
             if entry is not None:
-                entry.frames[int(frame_id)] = {
-                    "data": data, "partial": partial,
-                    "delivered": False, "ok": None}
+                mirror = {"data": data, "partial": partial,
+                          "delivered": False, "ok": None}
+                if trace_id:
+                    mirror["tid"] = str(trace_id)
+                entry.frames[int(frame_id)] = mirror
         record = {"t": "frame", "s": stream_id, "f": int(frame_id),
                   "data": data}
         if partial:
             record["partial"] = True
+        if trace_id:
+            # A replay after adoption re-ingests with this trace_id:
+            # the frame's spans keep joining its ORIGINAL door-to-
+            # decode trace across the process kill.
+            record["tid"] = str(trace_id)
         return self._append(record)
 
     def frame_done(self, stream_id: str, frame_id: int,
@@ -420,6 +427,8 @@ class StreamJournal:
                                   "f": fid, "data": frame["data"]}
                         if frame.get("partial"):
                             record["partial"] = True
+                        if frame.get("tid"):
+                            record["tid"] = frame["tid"]
                         records.append(record)
                     for fid in sorted(entry.llm):
                         records.append({"t": "llm",
@@ -552,10 +561,12 @@ def _apply(state: JournalState, record: dict) -> None:
         entry = StreamEntry(stream_id)
         state.streams[stream_id] = entry
     if kind == "frame":
-        entry.frames[int(record.get("f", 0))] = {
-            "data": dict(record.get("data") or {}),
-            "partial": bool(record.get("partial", False)),
-            "delivered": False, "ok": None}
+        mirror = {"data": dict(record.get("data") or {}),
+                  "partial": bool(record.get("partial", False)),
+                  "delivered": False, "ok": None}
+        if record.get("tid"):
+            mirror["tid"] = str(record["tid"])
+        entry.frames[int(record.get("f", 0))] = mirror
     elif kind == "done":
         entry.mark_done(int(record.get("f", 0)),
                         record.get("ok", True))

@@ -242,6 +242,40 @@ class Pipeline(Actor):
                     0.0)))
             tags.append(f"gateway={self.gateway.host}:"
                         f"{self.gateway.port}")
+        # Fleet observability (ISSUE 19): ``metrics_port`` binds the
+        # telemetry HTTP endpoint BEFORE the actor registers -- like
+        # the gateway and the tensor pipe -- so the registrar record
+        # advertises ``metrics=host:port`` and a fleet aggregator can
+        # discover every member's scrape endpoint with no static
+        # config.  Port 0 = kernel-assigned, echoed on
+        # ``share["metrics_port"]``.
+        self.metrics_server = None
+        metrics_port = definition.parameters.get("metrics_port")
+        if metrics_port is not None:
+            telemetry_off = str(definition.parameters.get(
+                "telemetry", "on")).strip().lower() in \
+                ("off", "false", "0")
+            if telemetry_off:
+                # Binding an endpoint that can only 404 would turn
+                # every fleet scrape into an error: say so at create.
+                _logger.warning("metrics_port is set but telemetry=off:"
+                                " endpoint not bound")
+            else:
+                from ..observability.exporter import MetricsServer
+                metrics_host = str(definition.parameters.get(
+                    "metrics_host", "127.0.0.1"))
+                try:
+                    self.metrics_server = MetricsServer(
+                        self,
+                        port=int(parse_number(metrics_port, 0)),
+                        host=metrics_host)
+                except OSError as error:
+                    self._construction_failed()
+                    raise DefinitionError(
+                        f"pipeline {definition.name!r}: metrics_port="
+                        f"{metrics_port!r} bind failed ({error})")
+                tags.append(f"metrics={metrics_host}:"
+                            f"{self.metrics_server.port}")
         # Durable stream journal + process fault domain (ISSUE 13):
         # ``journal: on`` appends each stream's recoverable state
         # (parameters, per-frame ingest payloads, delivery commits,
@@ -348,6 +382,26 @@ class Pipeline(Actor):
                 # policy past create.
                 raise DefinitionError(
                     f"pipeline {definition.name!r}: {error}")
+            # Per-tenant SLO error budgets (ISSUE 19): objectives
+            # usually live inside the qos block (``qos: {slo: ...}``);
+            # a top-level ``slo`` parameter attaches the same burn
+            # engine without any admission policy.  Validated here so a
+            # bad block is a create-time DefinitionError even under
+            # ``preflight: off``.
+            slo_spec = definition.parameters.get("slo")
+            if slo_spec is not None:
+                from ..gateway.qos import SloTracker, slo_spec_error
+                slo_problem = slo_spec_error(slo_spec)
+                if slo_problem:
+                    raise DefinitionError(
+                        f"pipeline {definition.name!r}: {slo_problem}")
+                if isinstance(slo_spec, str):
+                    import json as json_module
+                    slo_spec = json_module.loads(slo_spec)
+                if self.qos is None:
+                    self.qos = QosScheduler()
+                self.qos.slo = SloTracker(slo_spec)
+            self.share["slo_burn"] = {}
             self._qos_promotions = 0
             self._qos_sheds = 0
             self.share["qos_promotions"] = 0
@@ -458,6 +512,29 @@ class Pipeline(Actor):
 
             if self.gateway is not None:
                 self.share["gateway_port"] = self.gateway.port
+            if self.metrics_server is not None:
+                self.share["metrics_port"] = self.metrics_server.port
+
+            # Fleet aggregator (ISSUE 19): ``fleet: on`` runs the
+            # registrar-discovered collector in this process --
+            # scraping every member advertising a ``metrics=`` or
+            # ``gateway=`` tag -- and mounts it on the gateway's
+            # ``/fleet*`` routes when the door is open.
+            self.fleet_collector = None
+            fleet_mode = str(definition.parameters.get(
+                "fleet", "off")).strip().lower()
+            if fleet_mode in ("on", "true", "1"):
+                from ..observability.fleet import (
+                    FLEET_SCRAPE_MS_DEFAULT, FleetCollector)
+                self.fleet_collector = FleetCollector(
+                    runtime=self.runtime,
+                    scrape_ms=float(parse_number(
+                        definition.parameters.get("fleet_scrape_ms"),
+                        FLEET_SCRAPE_MS_DEFAULT)),
+                    local=self)
+                self.fleet_collector.start()
+                if self.gateway is not None:
+                    self.gateway.fleet = self.fleet_collector
 
             self._health_timer = None
             interval = self.definition.parameters.get("health_check_interval")
@@ -479,6 +556,19 @@ class Pipeline(Actor):
             if fault_plan:
                 self.arm_faults(fault_plan)
         except BaseException:
+            # The actor registered at the top of this try block: a
+            # create-time failure (bad qos/slo spec, graph error) must
+            # not leave a half-constructed pipeline discoverable.
+            service_id = getattr(self, "service_id", None)
+            if service_id is not None and self.runtime is not None:
+                self.runtime.remove_service(service_id)
+            fleet = getattr(self, "fleet_collector", None)
+            if fleet is not None:
+                fleet.stop()
+                self.fleet_collector = None
+            if self.metrics_server is not None:
+                self.metrics_server.stop()
+                self.metrics_server = None
             if self.gateway is not None:
                 self.gateway.stop()
                 self.gateway = None
@@ -499,6 +589,9 @@ class Pipeline(Actor):
         pipe) when ``__init__`` aborts BEFORE its guarded try block --
         a create-time DefinitionError must not leak an accepting
         socket."""
+        if getattr(self, "metrics_server", None) is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         if self.gateway is not None:
             self.gateway.stop()
             self.gateway = None
@@ -2038,6 +2131,29 @@ class Pipeline(Actor):
         stats["sheds_recorded"] = self._qos_sheds
         return stats
 
+    def note_slo_burn(self, fired=None, burns=None) -> None:
+        """SLO burn telemetry handed over from the gateway's result
+        pump (event-loop method via ``post_self``: share, ring and
+        black-box are not pump-thread-safe).  ``burns`` refreshes the
+        ``slo_burn`` share key; each ``fired`` entry is a fast burn --
+        ring event plus debounced black-box dump, because the error
+        budget is burning NOW and the ring tail holds the frames that
+        burned it."""
+        if burns is not None:
+            self.share["slo_burn"] = {
+                str(tenant): {str(cls): entry.get("burn")
+                              for cls, entry in classes.items()}
+                for tenant, classes in burns.items()}
+        for entry in fired or ():
+            tenant, qos_class, burn = entry[0], entry[1], entry[2]
+            self._rec("slo_burn", None, None, str(tenant), None,
+                      {"cls": str(qos_class),
+                       "burn": round(float(burn), 3)})
+            self._blackbox(
+                "slo_burn",
+                detail=f"tenant {tenant} class {qos_class} "
+                       f"burn {float(burn):.2f}x")
+
     def _stamp_deadline(self, stream: Stream, frame: Frame) -> None:
         if not stream.deadline_ms:
             return
@@ -2187,6 +2303,30 @@ class Pipeline(Actor):
         merge of same-id frames, which would attribute one frame's
         waits to another's compute and terminate the timeline at the
         wrong ``done``."""
+        trace = None
+        if isinstance(frame_id, str):
+            # A gateway-minted trace id names the request end to end:
+            # resolve it to the frame/stream its spans carry, then
+            # explain that frame as usual (one id, door to decode).
+            # Trace-id lookup first: an (unlikely) all-digit trace id
+            # must not silently degrade to a frame-id lookup.
+            if self.telemetry is not None:
+                trace = self.telemetry.traces.get(frame_id)
+            if trace is None:
+                if frame_id.lstrip("-").isdigit():
+                    frame_id = int(frame_id)
+                else:
+                    return None
+        if trace is not None:
+            frame_id, span_stream = None, None
+            for span in trace.get("spans", []):
+                if span.get("frame") is not None:
+                    frame_id = span["frame"]
+                    span_stream = span.get("stream") or span_stream
+            if frame_id is None:
+                return None
+            if stream_id is None:
+                stream_id = span_stream
         events = []
         if self.recorder is not None:
             if stream_id is None:
@@ -2196,8 +2336,10 @@ class Pipeline(Actor):
             if stream_id is not None:
                 events = self.recorder.frame_events(stream_id,
                                                     frame_id)
-        trace = None if self.telemetry is None else \
-            self.telemetry.traces.by_frame(frame_id, stream=stream_id)
+        if trace is None:
+            trace = None if self.telemetry is None else \
+                self.telemetry.traces.by_frame(frame_id,
+                                               stream=stream_id)
         if not events and trace is None:
             return None
         result: dict = {"frame": int(frame_id),
@@ -2697,9 +2839,13 @@ class Pipeline(Actor):
                         frame_id, error)
                     continue
                 replayed += 1
+                # The journaled trace_id rides the replay: the frame's
+                # spans on THIS pipeline continue the original door-to-
+                # decode trace across the process kill.
                 self._ingest({"stream_id": entry.stream_id,
                               "frame_id": frame_id,
-                              "response_topic": topic}, data)
+                              "response_topic": topic,
+                              "trace_id": record.get("tid")}, data)
         self._streams_adopted += adopted
         self._frames_journal_replayed += replayed
         self.share["streams_adopted"] = self._streams_adopted
@@ -2849,18 +2995,22 @@ class Pipeline(Actor):
     def process_frame_local(self, frame_data: dict,
                             stream_id=DEFAULT_STREAM_ID,
                             queue_response=None,
-                            frame_id=None) -> None:
+                            frame_id=None, trace_id=None,
+                            trace_parent=None) -> None:
         """In-process API: no encoding, swag values pass by reference.
         Thread-safe (hops through the actor mailbox).  An explicit
         ``frame_id`` lets a session-owning caller (the gateway) keep
         one frame-id space across pipeline failovers, so delivery
-        dedupe works no matter which peer answers."""
+        dedupe works no matter which peer answers.  ``trace_id`` /
+        ``trace_parent`` let a door-owning caller (the gateway) root
+        this frame's spans under ITS trace instead of minting a new
+        one -- the in-process twin of the wire header's trace fields."""
         self.post_self("ingest_local",
                        [str(stream_id), frame_data, queue_response,
-                        frame_id])
+                        frame_id, trace_id, trace_parent])
 
     def ingest_local(self, stream_id, frame_data, queue_response=None,
-                     frame_id=None):
+                     frame_id=None, trace_id=None, trace_parent=None):
         stream = self.streams.get(str(stream_id))
         if stream is None:
             stream = self.create_stream_local(stream_id,
@@ -2876,7 +3026,8 @@ class Pipeline(Actor):
             stream.frame_count = max(stream.frame_count, frame_id + 1)
         frame = Frame(frame_id=frame_id, swag=dict(frame_data))
         if self.telemetry is not None:
-            self.telemetry.frame_started(frame)
+            self.telemetry.frame_started(frame, trace_id=trace_id,
+                                         parent_id=trace_parent)
         self._rec("ingest", stream.stream_id, frame.frame_id)
         self._stamp_qos(stream, frame)
         shed = self._shed_for_overload(stream) \
@@ -2963,7 +3114,8 @@ class Pipeline(Actor):
         if self.journal is None or not stream.journal:
             return
         lag = self.journal.frame_ingested(stream.stream_id,
-                                          frame.frame_id, frame.swag)
+                                          frame.frame_id, frame.swag,
+                                          trace_id=frame.trace_id)
         if lag >= 256:
             # The fsync backlog grew a whole batch window deep --
             # frames in it are past the durability horizon if the host
@@ -4663,6 +4815,13 @@ class Pipeline(Actor):
     def stop(self):
         self._cancel_health_timer()
         self.disarm_faults()
+        fleet = getattr(self, "fleet_collector", None)
+        if fleet is not None:
+            fleet.stop()
+            self.fleet_collector = None
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         if self.gateway is not None:
             # Before streams: a live WebSocket session must stop
             # feeding frames before its stream tears down under it.

@@ -2830,6 +2830,253 @@ def bench_pipeline_failover() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Fleet observability plane (ISSUE 19): collector scrape overhead on a
+# loaded pipeline (gated <= 1%), the door-to-decode trace a gateway
+# request produces (span count + attribution coverage), and SLO
+# error-budget burn firing under 2x overload.
+
+FLEET_BUSY_MS = 4.0
+FLEET_FRAMES = 120
+FLEET_OVERHEAD_GATE_PCT = 1.0
+FLEET_SCRAPE_FAST_MS = 50.0     # ~20 Hz: far above production cadence,
+                                # so the gate bounds a WORST case
+
+
+def bench_pipeline_fleet() -> dict:
+    import json as json_module
+    import queue
+    import threading
+    import time as time_module
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    if len(jax.devices()) < 2:
+        return {"pipeline_fleet_skipped":
+                f"needs >= 2 devices, have {len(jax.devices())}"}
+    from aiko_services_tpu.gateway.client import GatewayClient
+    from aiko_services_tpu.gateway.loadgen import LoadSpec, run_loadgen
+    from aiko_services_tpu.pipeline import Pipeline
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.services import Registrar
+    from aiko_services_tpu.services.share import reset_services_cache
+    from aiko_services_tpu.transport import reset_broker
+
+    payload = {"x": np.ones((64,), np.float32)}
+
+    def fresh_runtime():
+        reset_broker()
+        reset_services_cache()
+        reset_process()
+        runtime = init_process(transport="loopback")
+        runtime.initialize()
+        return runtime
+
+    def make_pipeline(runtime, name, fleet, extra=None):
+        parameters: dict = dict(extra or {})
+        if fleet:
+            parameters.update({"fleet": "on",
+                               "fleet_scrape_ms": FLEET_SCRAPE_FAST_MS})
+        return Pipeline(
+            {"version": 0, "name": name, "runtime": "jax",
+             "graph": ["(work finish)"],
+             "parameters": parameters,
+             "elements": [
+                 {**element("work", "StageWork", ["x"], ["x"],
+                            {"busy_ms": FLEET_BUSY_MS, "factor": 2.0}),
+                  "placement": {"devices": 2}},
+                 {**element("finish", "StageWork", ["x"], ["x"],
+                            {"busy_ms": FLEET_BUSY_MS, "factor": 3.0}),
+                  "placement": {"devices": 2}},
+             ]}, runtime=runtime)
+
+    result: dict = {}
+
+    # -- scrape overhead A/B: same workload, collector on vs off ---------
+    # The collector scrapes the local pipeline's registry snapshot at
+    # FLEET_SCRAPE_FAST_MS off-thread while the engine pushes frames.
+    def measure_fps(fleet: bool) -> float:
+        runtime = fresh_runtime()
+        try:
+            pipeline = make_pipeline(runtime, "fmeas", fleet)
+            for stream_id, frames in (("warm", 16),
+                                      ("meas", FLEET_FRAMES)):
+                responses = queue.Queue()
+                pipeline.create_stream_local(
+                    stream_id, queue_response=responses)
+                start = time_module.perf_counter()
+                for _ in range(frames):
+                    pipeline.process_frame_local(dict(payload),
+                                                 stream_id=stream_id)
+                runtime.run(until=lambda: responses.qsize() == frames,
+                            timeout=120.0)
+                elapsed = time_module.perf_counter() - start
+                if responses.qsize() != frames:
+                    raise RuntimeError(
+                        f"fleet fps pass hung at "
+                        f"{responses.qsize()}/{frames}")
+            return frames / elapsed
+        finally:
+            runtime.terminate()
+
+    # Scheduler jitter can exceed a 1% gate on a loaded CPU host:
+    # re-measure up to 3x (the recorder-overhead discipline) -- a
+    # genuine >1% scrape cost fails all attempts.
+    for _attempt in range(3):
+        fps_off = measure_fps(fleet=False)
+        fps_on = measure_fps(fleet=True)
+        overhead_pct = (fps_off - fps_on) / fps_off * 100.0
+        if overhead_pct <= FLEET_OVERHEAD_GATE_PCT:
+            break
+    result.update({
+        "pipeline_nofleet_fps": round(fps_off, 2),
+        "pipeline_fleet_fps": round(fps_on, 2),
+        "fleet_scrape_overhead_pct": round(overhead_pct, 2),
+        "fleet_overhead_within_gate":
+            bool(overhead_pct <= FLEET_OVERHEAD_GATE_PCT),
+    })
+
+    # -- door-to-decode trace + /fleet + SLO burn under overload ---------
+    runtime = fresh_runtime()
+    try:
+        Registrar(runtime=runtime, primary_search_timeout=0.05)
+        # A p99 objective of 1 ms against an ~8 ms two-stage workload:
+        # every delivered frame violates it, so the latency burn is
+        # ~100x the budget and the fast-burn path MUST fire once the
+        # overload pass pushes samples through the window.
+        pipeline = make_pipeline(
+            runtime, "fgw", fleet=True,
+            extra={"gateway": "on",
+                   "qos": {"tenants": {"alice":
+                                       {"class": "interactive",
+                                        "budget": 64}},
+                           "max_inflight": 24,
+                           "session_window": 64},
+                   "slo": {"interactive": {"p99_ms": 1.0,
+                                           "availability": 0.999}}})
+        port = pipeline.gateway.port
+
+        # One traced request end to end via the real WebSocket door.
+        box: dict = {}
+
+        def probe():
+            try:
+                client = GatewayClient("127.0.0.1", port, timeout=60.0)
+                client.open(session="trace-probe", tenant="alice",
+                            qos_class="interactive")
+                client.send_frame({"x": [1.0] * 64})
+                box["message"] = client.next_result(timeout=60.0)
+                client.close()
+            except Exception as error:
+                box["error"] = f"{type(error).__name__}: {error}"
+
+        thread = threading.Thread(target=probe, daemon=True)
+        thread.start()
+        runtime.run(until=lambda: not thread.is_alive(), timeout=60.0)
+        if "message" not in box:
+            result["pipeline_fleet_error"] = \
+                box.get("error", "trace probe hung")
+            return result
+        trace_id = box["message"].get("trace")
+        trace = None if trace_id is None \
+            else pipeline.telemetry.traces.get(str(trace_id))
+        if trace is None:
+            result["pipeline_fleet_error"] = \
+                f"gateway result carried no resolvable trace " \
+                f"(trace={trace_id!r})"
+            return result
+        spans = trace["spans"]
+        gateway_spans = sum(1 for span in spans
+                            if span.get("kind") == "gateway")
+        result.update({
+            "fleet_trace_spans": len(spans),
+            "fleet_trace_gateway_spans": gateway_spans,
+            "fleet_trace_one_id": all(
+                span.get("trace_id") == str(trace_id)
+                for span in spans),
+        })
+        explain = pipeline.explain_frame(str(trace_id))
+        if explain is not None and explain.get("coverage") is not None:
+            result["fleet_trace_attribution_coverage"] = \
+                explain["coverage"]
+        if gateway_spans < 3 or len(spans) <= gateway_spans:
+            result["pipeline_fleet_error"] = \
+                f"door-to-decode trace incomplete: {len(spans)} " \
+                f"span(s), {gateway_spans} from the gateway"
+            return result
+
+        # 2x overload through the door; the 1 ms objective burns.
+        rate = 120.0
+        spec = LoadSpec("alice", "interactive", rate=rate,
+                        frames=int(rate * 2.0),
+                        data={"x": [1.0] * 64}, window=32)
+
+        def drive_load():
+            try:
+                box["report"] = run_loadgen("127.0.0.1", port, [spec])
+            except Exception as error:
+                box["load_error"] = f"{type(error).__name__}: {error}"
+
+        thread = threading.Thread(target=drive_load, daemon=True)
+        thread.start()
+        runtime.run(until=lambda: not thread.is_alive(), timeout=120.0)
+        # One more engine beat so the posted note_slo_burn lands on the
+        # share dict.
+        deadline = time_module.monotonic() + 0.5
+        runtime.run(until=lambda: time_module.monotonic() > deadline,
+                    timeout=5.0)
+        snapshot = pipeline.qos.slo.snapshot()
+        burns = snapshot.get("tenants", {}).get("alice", {})
+        burn = (burns.get("interactive") or {}).get("burn", 0.0)
+        result.update({
+            "fleet_slo_fast_burns": snapshot.get("fired", 0),
+            "fleet_slo_burn": burn,
+            "fleet_slo_burn_on_share":
+                bool(pipeline.share.get("slo_burn")),
+        })
+        if not snapshot.get("fired"):
+            result["pipeline_fleet_error"] = \
+                "SLO fast burn never fired under 2x overload against " \
+                "a 1 ms p99 objective (burn plumbing broken)"
+
+        # The in-process collector has been scraping at 20 Hz through
+        # all of the above: /fleet must answer with merged rows and
+        # ZERO scrape errors.
+        collector = pipeline.fleet_collector
+        collector.scrape_once()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet",
+                timeout=10.0) as reply:
+            fleet_text = reply.read().decode()
+        rows = collector.members_snapshot()
+        result.update({
+            "fleet_scrapes": int(sum(row["scrapes"] for row in rows)),
+            "fleet_scrape_errors": int(sum(row["errors"]
+                                           for row in rows)),
+            "fleet_exposition_has_latency":
+                "aiko_frame_latency_ms" in fleet_text,
+        })
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet/slo",
+                timeout=10.0) as reply:
+            fleet_slo = json_module.loads(reply.read().decode())
+        result["fleet_slo_endpoint_sees_burn"] = bool(
+            (fleet_slo.get("tenants") or {}).get("alice"))
+    finally:
+        runtime.terminate()
+
+    previous = _previous_bench()
+    for key in ("pipeline_fleet_fps", "pipeline_nofleet_fps",
+                "fleet_trace_spans", "fleet_slo_burn"):
+        prior = previous.get(key)
+        if prior and result.get(key):
+            result[f"{key}_vs_baseline"] = round(result[key] / prior,
+                                                 2)
+    return result
+
+
+# ---------------------------------------------------------------------------
 # 5. ASR real-time factor (BASELINE config 5): seconds of audio
 #    transcribed per wall-clock second, batch of chunks, one dispatch
 #    (mel frontend + encoder + KV-cached 128-token greedy decode all
@@ -3106,6 +3353,7 @@ def main() -> int:
             ("bench_pipeline_replicas", bench_pipeline_replicas),
             ("bench_pipeline_gateway", bench_pipeline_gateway),
             ("bench_pipeline_failover", bench_pipeline_failover),
+            ("bench_pipeline_fleet", bench_pipeline_fleet),
             ("bench_asr", lambda: bench_asr(rtt)),
             ("bench_speech_e2e", bench_speech_e2e)):
         if wanted and name.removeprefix("bench_") not in wanted:

@@ -1,0 +1,508 @@
+"""Fleet observability plane (ISSUE 19): door-to-decode tracing
+through the real gateway, registrar-discovered metrics federation with
+EXACT histogram merge + monotonic counters across death/adoption, and
+per-tenant SLO error budgets.
+
+Acceptance shapes:
+
+- a WebSocket request through the gateway to a placed pipeline with a
+  remote hop is ONE trace -- gateway spans, origin spans and remote
+  spans under one trace_id, resolvable by ``explain_frame``;
+- the SAME trace_id continues across a kill-mid-stream failover:
+  the journal records it per frame, the adopter's replay re-ingests
+  with it, and the client's post-failover result names it;
+- a collector scraping >= 2 live processes merges histograms exactly
+  (fleet quantile == the quantile of a hand-merged reference) and its
+  counters never decrease across rolling restart or SIGKILL+adoption,
+  with zero scrape errors.
+"""
+
+import json
+import queue
+import threading
+import time
+
+import pytest
+
+from conftest import run_until
+
+from aiko_services_tpu.gateway.client import GatewayClient
+from aiko_services_tpu.gateway.qos import (SLO_FIRE_COOLDOWN_S,
+                                           SloTracker, slo_spec_error)
+from aiko_services_tpu.gateway.server import GatewayServer
+from aiko_services_tpu.observability import LogHistogram
+from aiko_services_tpu.observability.fleet import FleetCollector
+from aiko_services_tpu.pipeline import DefinitionError, Pipeline
+from aiko_services_tpu.pipeline.journal import load_journal
+from aiko_services_tpu.services import Registrar
+
+COMMON = "aiko_services_tpu.elements.common"
+
+
+def element(name, cls, parameters=None, placement=None):
+    definition = {"name": name, "input": [{"name": "x"}],
+                  "output": [{"name": "x"}],
+                  "deploy": {"local": {"module": COMMON,
+                                       "class_name": cls}},
+                  "parameters": parameters or {}}
+    if placement:
+        definition["placement"] = placement
+    return definition
+
+
+def remote(name, target):
+    return {"name": name, "input": [{"name": "x"}],
+            "output": [{"name": "x"}],
+            "deploy": {"remote": {"name": target}}}
+
+
+def stage(name, busy_ms=1.0, factor=2.0, devices=2):
+    return element(name, "StageWork",
+                   {"busy_ms": busy_ms, "factor": factor},
+                   placement={"devices": devices})
+
+
+def simple_pipeline(runtime, name, extra=None):
+    parameters = dict(extra or {})
+    return Pipeline({"version": 0, "name": name, "runtime": "jax",
+                     "graph": ["(inc)"],
+                     "parameters": parameters,
+                     "elements": [element("inc", "Increment")]},
+                    runtime=runtime)
+
+
+def push_frames(runtime, pipeline, stream_id, n):
+    responses = queue.Queue()
+    pipeline.create_stream_local(stream_id, queue_response=responses)
+    for _ in range(n):
+        pipeline.process_frame_local({"x": 0}, stream_id=stream_id)
+    assert run_until(runtime, lambda: responses.qsize() == n,
+                     timeout=30.0)
+
+
+def in_thread(target):
+    box: dict = {}
+
+    def body():
+        try:
+            box["value"] = target()
+        except Exception as error:      # surfaced by the test
+            box["error"] = error
+    thread = threading.Thread(target=body, daemon=True)
+    thread.start()
+    return thread, box
+
+
+def finish(runtime, thread, box, timeout=90.0):
+    run_until(runtime, lambda: not thread.is_alive(), timeout=timeout)
+    assert not thread.is_alive(), "client interaction hung"
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+# -- SLO engine (jax-free units) --------------------------------------------
+
+def test_slo_spec_error_vocabulary():
+    assert slo_spec_error({}) is None
+    assert slo_spec_error({"interactive": {"p99_ms": 100,
+                                           "availability": 0.999,
+                                           "window_s": 30}}) is None
+    assert "dict" in slo_spec_error([1, 2])
+    assert "dict" in slo_spec_error({"interactive": 5})
+    assert "unknown" in slo_spec_error(
+        {"interactive": {"p99": 100}})
+    assert "declare" in slo_spec_error(
+        {"interactive": {"window_s": 30}})
+    assert "p99_ms" in slo_spec_error(
+        {"interactive": {"p99_ms": 0}})
+    assert "availability" in slo_spec_error(
+        {"interactive": {"availability": 1.0}})
+    assert "availability" in slo_spec_error(
+        {"interactive": {"availability": 1.5}})
+    assert "availability" in slo_spec_error(
+        {"interactive": {"availability": 0.0}})
+
+
+def test_slo_tracker_burn_and_debounce():
+    tracker = SloTracker({"interactive": {"p99_ms": 10.0,
+                                          "availability": 0.9,
+                                          "window_s": 60.0}})
+    now = 1000.0
+    # In budget: fast, successful frames -> zero burn, nothing fires.
+    for _ in range(50):
+        tracker.observe("alice", "interactive", 2.0, True, now=now)
+    assert tracker.fast_burns(now=now) == []
+    burns = tracker.burn_rates(now=now)
+    assert burns["alice"]["interactive"]["burn"] == 0.0
+    # Untracked class: no objective, no samples, no crash.
+    tracker.observe("alice", "batch", 500.0, False, now=now)
+    assert "batch" not in burns.get("alice", {})
+
+    # Burn: every frame over the latency objective -> latency burn
+    # 100x (100% violations against the 1% budget a p99 implies).
+    for _ in range(50):
+        tracker.observe("bob", "interactive", 50.0, True, now=now)
+    fired = tracker.fast_burns(now=now)
+    assert ("bob", "interactive") in [(t, c) for t, c, _ in fired]
+    burn = tracker.burn_rates(now=now)["bob"]["interactive"]
+    assert burn["latency_burn"] == pytest.approx(100.0)
+    # Debounced: an immediate re-check does not re-fire ...
+    assert tracker.fast_burns(now=now + 1.0) == []
+    # ... but after the cooldown a sustained burn fires again.
+    tracker.observe("bob", "interactive", 50.0, True,
+                    now=now + SLO_FIRE_COOLDOWN_S + 1.0)
+    again = tracker.fast_burns(now=now + SLO_FIRE_COOLDOWN_S + 1.0)
+    assert ("bob", "interactive") in [(t, c) for t, c, _ in again]
+    assert tracker.fired == 2
+
+    # Availability burn from latency-less bad events (rejects/sheds).
+    for _ in range(20):
+        tracker.observe("carol", "interactive", None, False, now=now)
+    entry = tracker.burn_rates(now=now)["carol"]["interactive"]
+    assert entry["availability_burn"] == pytest.approx(10.0)
+    snapshot = tracker.snapshot(now=now)
+    assert snapshot["objectives"]["interactive"]["p99_ms"] == 10.0
+    assert "carol" in snapshot["tenants"]
+
+
+def test_bad_slo_is_create_time_error_even_without_preflight(runtime):
+    with pytest.raises(DefinitionError, match="availability"):
+        Pipeline({"version": 0, "name": "badslo", "runtime": "jax",
+                  "graph": ["(inc)"],
+                  "parameters": {"preflight": "off",
+                                 "slo": {"interactive":
+                                         {"p99_ms": 50,
+                                          "availability": 1.5}}},
+                  "elements": [element("inc", "Increment")]},
+                 runtime=runtime)
+    assert "badslo" not in [getattr(s, "name", "") for s in
+                            runtime.services()]
+
+
+# -- door-to-decode tracing -------------------------------------------------
+
+def test_ws_request_is_one_trace_gateway_origin_remote(runtime):
+    """A WebSocket frame through the real gateway into a placed
+    pipeline with a remote hop yields ONE trace: gateway spans (root +
+    admit + pump), origin spans, and the remote pipeline's spans, all
+    under the trace_id the client's result names."""
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    # The remote hop receives the placed stage's ARRAY output, so the
+    # back element must be array-capable (StageWork, not Increment).
+    back = Pipeline(
+        {"version": 0, "name": "back", "runtime": "jax",
+         "graph": ["(inc)"],
+         "parameters": {},
+         "elements": [element("inc", "StageWork", {"busy_ms": 1.0})]},
+        runtime=runtime)
+    front = Pipeline(
+        {"version": 0, "name": "front", "runtime": "jax",
+         "graph": ["(work (fwd))"],
+         "parameters": {"gateway": "on"},
+         "elements": [stage("work", busy_ms=1.0),
+                      remote("fwd", "back")]},
+        runtime=runtime)
+    fwd = front.graph.get_node("fwd").element
+    run_until(runtime, lambda: fwd.remote_topic_path is not None,
+              timeout=10.0)
+    client = GatewayClient("127.0.0.1", front.gateway.port,
+                           timeout=60.0)
+
+    def interact():
+        client.open(session="t1", tenant="alice")
+        client.send_frame({"x": [1.0] * 4})
+        message = client.next_result(timeout=60.0)
+        client.close()
+        return message
+
+    thread, box = in_thread(interact)
+    message = finish(runtime, thread, box)
+    assert message["ok"], message
+    trace_id = message.get("trace")
+    assert trace_id, "gateway result carried no trace id"
+
+    trace = front.telemetry.traces.get(str(trace_id))
+    assert trace is not None
+    spans = trace["spans"]
+    assert {span["trace_id"] for span in spans} == {str(trace_id)}
+    kinds = [span["kind"] for span in spans]
+    names = {span["name"] for span in spans}
+    processes = {span["process"] for span in spans}
+    assert kinds.count("gateway") >= 3          # root + admit + pump
+    assert {"gateway:admit", "gateway:pump"} <= names
+    assert {"front", "back"} <= processes       # origin + remote hop
+    # The gateway root is the trace root; the engine's spans hang
+    # below it (the dispatched frame carried trace_id + parent).
+    root = next(span for span in spans
+                if span["kind"] == "gateway"
+                and span["parent_id"] is None)
+    frame_roots = [span for span in spans if span["kind"] == "frame"
+                   and span["process"] == "front"]
+    assert frame_roots and all(span["parent_id"] == root["span_id"]
+                               for span in frame_roots)
+    # explain_frame resolves the gateway-minted id end to end.
+    explained = front.explain_frame(str(trace_id))
+    assert explained is not None
+    assert explained["trace_id"] == str(trace_id)
+    front.stop()
+    back.stop()
+
+
+def test_trace_id_survives_kill_failover_replay(runtime, tmp_path):
+    """The journal records each frame's trace_id; after SIGKILL (the
+    in-process twin) + adoption, replayed frames continue their
+    ORIGINAL trace -- the id the client's late result names matches
+    the dead process's journal, and the adopter's spans join it."""
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+
+    def serving(name, busy_ms):
+        return Pipeline(
+            {"version": 0, "name": name, "runtime": "jax",
+             "graph": ["(work finish)"],
+             "parameters": {"journal": "on",
+                            "journal_dir": str(tmp_path)},
+             "elements": [stage("work", busy_ms),
+                          stage("finish", busy_ms, factor=3.0)]},
+            runtime=runtime)
+
+    p1 = serving("srv1", busy_ms=120.0)
+    gateway = GatewayServer(runtime=runtime)
+    run_until(runtime, lambda: len(gateway._peers) == 1)
+    p2 = serving("srv2", busy_ms=5.0)
+    run_until(runtime, lambda: len(gateway._peers) == 2)
+
+    client = GatewayClient("127.0.0.1", gateway.port, timeout=90.0)
+    n_frames = 5
+
+    def phase_send():
+        client.open(session="s1", tenant="t1")
+        for index in range(n_frames):
+            client.send_frame({"x": [float(index + 1)] * 4})
+        return client.next_result()     # at least one from srv1
+
+    thread, box = in_thread(phase_send)
+    first = finish(runtime, thread, box)
+    assert first["frame"] == 0 and first["ok"]
+    assert first.get("trace"), "pre-kill result carried no trace id"
+
+    # The dead-to-be journal knows each ingested frame's trace id.
+    entry = load_journal(tmp_path / "srv1.journal").streams["gw/s1"]
+    journal_tids = {frame_id: mirror.get("tid")
+                    for frame_id, mirror in entry.frames.items()}
+    assert all(journal_tids.get(frame_id) for frame_id
+               in range(1, n_frames) if frame_id in journal_tids), \
+        f"journal missing trace ids: {journal_tids}"
+
+    p1.kill()                           # unclean death, mid-stream
+    run_until(runtime, lambda: gateway.failovers == 1, timeout=10.0)
+    run_until(runtime, lambda: p2.share["streams_adopted"] == 1,
+              timeout=10.0)
+
+    def phase_recv():
+        results = [client.next_result() for _ in range(n_frames - 1)]
+        client.close()
+        return results
+
+    thread, box = in_thread(phase_recv)
+    rest = finish(runtime, thread, box)
+    results = [first] + rest
+    assert [r["frame"] for r in results] == list(range(n_frames))
+    assert p2.share["frames_journal_replayed"] >= 1
+    for result in rest:
+        frame_id = result["frame"]
+        if frame_id not in journal_tids:
+            continue                    # delivered before the kill
+        # Same id across the process boundary: journal == result.
+        assert result.get("trace") == journal_tids[frame_id], \
+            f"frame {frame_id}: trace id changed across failover"
+    # The adopter's buffer holds the original trace with ITS spans.
+    replayed_tid = next(journal_tids[r["frame"]] for r in rest
+                        if r["frame"] in journal_tids)
+    adopted = p2.telemetry.traces.get(replayed_tid)
+    assert adopted is not None, \
+        "adopter holds no spans for the replayed frame's trace"
+    assert {span["process"] for span in adopted["spans"]} == {"srv2"}
+    # The standalone door holds the WHOLE trace: its own gateway
+    # spans plus the adopter's wire-returned spans, one id.
+    own = gateway._own_traces.get(replayed_tid)
+    assert own is not None
+    kinds = {span["kind"] for span in own["spans"]}
+    assert "gateway" in kinds
+    assert "srv2" in {span["process"] for span in own["spans"]}
+    gateway.stop()
+    p2.stop()
+
+
+# -- fleet federation -------------------------------------------------------
+
+def test_fleet_merges_two_processes_exactly(runtime):
+    """Two live pipelines with real scrape endpoints: the collector's
+    merged histogram equals a hand-merged reference (same fixed bucket
+    edges -> merge is addition, quantiles agree EXACTLY), and the
+    exposition carries per-member rows plus aggregate rows."""
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    p1 = simple_pipeline(runtime, "m1", extra={"metrics_port": 0})
+    p2 = simple_pipeline(runtime, "m2", extra={"metrics_port": 0})
+    assert p1.metrics_server is not None
+    assert p1.share["metrics_port"] == p1.metrics_server.port
+
+    collector = FleetCollector(runtime=runtime, scrape_ms=0)
+    collector.start()
+    run_until(runtime,
+              lambda: len(collector.members_snapshot()) == 2,
+              timeout=10.0)
+
+    push_frames(runtime, p1, "s1", 6)
+    push_frames(runtime, p2, "s2", 9)
+    assert collector.scrape_once() == 0
+
+    reference = LogHistogram()
+    for pipeline in (p1, p2):
+        state = next(
+            entry for entry
+            in pipeline.telemetry.registry.state()["histograms"]
+            if entry["name"] == "frame_latency_ms"
+            and not entry["labels"])
+        reference.merge_state(state)
+    merged = collector.merged_histogram("frame_latency_ms")
+    assert merged.count == reference.count == 15
+    for q in (0.5, 0.9, 0.99):
+        assert merged.quantile(q, windowed=False) == \
+            reference.quantile(q, windowed=False)
+    assert collector.counter_value("frames_total",
+                                   {"status": "ok"}) == 15.0
+
+    text = collector.render_fleet_text()
+    assert 'aiko_frame_latency_ms{pipeline="m1",quantile="0.99"}' \
+        in text
+    assert 'aiko_frame_latency_ms{quantile="0.99"}' in text  # merged
+    assert 'aiko_frames_total{status="ok"} 15' in text
+    collector.stop()
+    p1.stop()
+    p2.stop()
+
+
+def test_fleet_counters_monotonic_across_churn(runtime, tmp_path):
+    """Rolling restart and SIGKILL+adoption must never make a fleet
+    counter decrease, and a scrape sweep over live members never
+    errors: death is membership (LWT retire banks the incarnation),
+    not a scrape failure."""
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+
+    def member(name):
+        return simple_pipeline(
+            runtime, name,
+            extra={"metrics_port": 0, "journal": "on",
+                   "journal_dir": str(tmp_path)})
+
+    p1 = member("c1")
+    p2 = member("c2")
+    collector = FleetCollector(runtime=runtime, scrape_ms=0)
+    collector.start()
+    run_until(runtime,
+              lambda: len(collector.members_snapshot()) == 2,
+              timeout=10.0)
+
+    push_frames(runtime, p1, "s1", 4)
+    push_frames(runtime, p2, "s2", 4)
+    assert collector.scrape_once() == 0
+    total = collector.counter_value("frames_total", {"status": "ok"})
+    assert total == 8.0
+
+    # Rolling restart: drain c1, recreate the SAME name, fresh counts.
+    p1.drain()
+    run_until(runtime, lambda: p1.share.get("drained"), timeout=30.0)
+    run_until(runtime,
+              lambda: not any(row["alive"] and row["name"] == "c1"
+                              for row in collector.members_snapshot()),
+              timeout=10.0)
+    p1b = member("c1")
+    run_until(runtime,
+              lambda: any(row["alive"] and row["name"] == "c1"
+                          for row in collector.members_snapshot()),
+              timeout=10.0)
+    push_frames(runtime, p1b, "s1b", 3)
+    assert collector.scrape_once() == 0
+    after_roll = collector.counter_value("frames_total",
+                                         {"status": "ok"})
+    # Banked 4 (dead incarnation) + fresh 3 + c2's 4: never backwards.
+    assert after_roll == 11.0
+    assert after_roll >= total
+
+    # SIGKILL twin: the dead member retires, totals stay banked.
+    p2.kill()
+    run_until(runtime,
+              lambda: not any(row["alive"] and row["name"] == "c2"
+                              for row in collector.members_snapshot()),
+              timeout=10.0)
+    assert collector.scrape_once() == 0
+    after_kill = collector.counter_value("frames_total",
+                                         {"status": "ok"})
+    assert after_kill == after_roll     # its frames happened
+    rows = collector.members_snapshot()
+    assert sum(row["errors"] for row in rows) == 0
+    assert collector.registry.state()["counters"] == [] or all(
+        entry["name"] != "fleet_scrape_errors"
+        for entry in collector.registry.state()["counters"])
+    collector.stop()
+    p1b.stop()
+
+
+def test_fleet_slo_and_trace_views(runtime):
+    """The in-gateway deployment: ``fleet: on`` inside a gateway
+    pipeline serves /fleet, /fleet/slo and /fleet/traces/<id> over the
+    door's own port, with the local pipeline scraped in-process."""
+    import urllib.request
+
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    pipeline = Pipeline(
+        {"version": 0, "name": "fgw", "runtime": "jax",
+         "graph": ["(inc)"],
+         "parameters": {"gateway": "on", "fleet": "on",
+                        "fleet_scrape_ms": 0,
+                        "slo": {"interactive":
+                                {"p99_ms": 0.001,
+                                 "availability": 0.999}}},
+         "elements": [element("inc", "Increment")]},
+        runtime=runtime)
+    port = pipeline.gateway.port
+    client = GatewayClient("127.0.0.1", port, timeout=60.0)
+
+    def interact():
+        client.open(session="sv", tenant="alice",
+                    qos_class="interactive")
+        client.send_frame({"x": 5})      # scalar: the graph is Increment
+        message = client.next_result(timeout=60.0)
+        client.close()
+        return message
+
+    thread, box = in_thread(interact)
+    message = finish(runtime, thread, box)
+    assert message["ok"], message
+    trace_id = str(message["trace"])
+    pipeline.fleet_collector.scrape_once()
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10.0) as r:
+            return r.read().decode()
+
+    fleet_text = get("/fleet")
+    assert 'pipeline="fgw"' in fleet_text
+    assert "aiko_fleet_members" in fleet_text
+    slo = json.loads(get("/fleet/slo"))
+    # The 1 us objective makes the single delivered frame a violation:
+    # the burn is visible fleet-wide.
+    assert slo["tenants"]["alice"]["interactive"]["burn"] > 1.0
+    # The share refresh rides post_self -> the pipeline's event loop.
+    assert run_until(runtime,
+                     lambda: pipeline.share.get("slo_burn"),
+                     timeout=10.0), \
+        "slo burn missing from the share dict"
+    assert pipeline.share["slo_burn"]["alice"]["interactive"] > 1.0
+    trace = json.loads(get(f"/fleet/traces/{trace_id}"))
+    assert trace["trace_id"] == trace_id
+    kinds = {span["kind"] for span in trace["spans"]}
+    assert "gateway" in kinds and len(trace["spans"]) >= 4
+    pipeline.stop()

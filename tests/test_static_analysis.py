@@ -41,6 +41,8 @@ DEFINITION_FIXTURES = {
     "bad_qos_tenant.json": "bad-parameter",
     "bad_journal.json": "bad-parameter",
     "bad_drain_timeout.json": "bad-parameter",
+    "bad_slo.json": "bad-parameter",
+    "bad_fleet.json": "bad-parameter",
     "data_plane_on_local.json": "data-plane-on-local",
     "bad_source.py": "bad-source",
     "undeclared_host_input.json": "undeclared-host-input",
