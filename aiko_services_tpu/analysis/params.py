@@ -214,6 +214,47 @@ PIPELINE_PARAMETERS: dict[str, ParamSpec] = {
         "window_s}} (dict or JSON) -- attaches the error-budget burn "
         "engine without a qos admission block (qos: {slo: ...} is the "
         "usual home)", kind="json"),
+    # -- guarded elastic fleet controller (ISSUE 20) -------------------
+    "controller": ParamSpec(
+        "fleet controller: off, observe (dry-run: journals every "
+        "decision it WOULD take, actuates nothing), on/act -- or a "
+        "spec dict {mode, interval_ms, action_budget, fleet_max, ...} "
+        "(dict or JSON)", kind="json"),
+    "controller_mode": ParamSpec(
+        "flat override of the controller mode",
+        choices=("off", "on", "observe", "act")),
+    "controller_interval_ms": ParamSpec(
+        "controller tick interval in ms",
+        number=True, minimum=1),
+    "controller_action_budget": ParamSpec(
+        "actions allowed per sliding budget window; past it the "
+        "controller refuses LOUDLY (error log + ring event + "
+        "black box)", number=True, minimum=1),
+    "controller_budget_window_s": ParamSpec(
+        "sliding window the action budget counts over",
+        number=True, minimum=1),
+    "controller_hysteresis_ticks": ParamSpec(
+        "consecutive ticks a diagnosis must persist before the "
+        "controller may act on it (oscillation damping)",
+        number=True, minimum=1),
+    "controller_cooldown_ms": ParamSpec(
+        "per-action-kind cooldown: the same knob is never touched "
+        "twice within this window", number=True, minimum=0),
+    "fleet_min": ParamSpec(
+        "process-pool floor the controller scales within (1 = just "
+        "this process)", number=True, minimum=1),
+    "fleet_max": ParamSpec(
+        "process-pool ceiling; > 1 arms the FleetSupervisor spawn "
+        "tier (act mode only)", number=True, minimum=1),
+    "fleet_definition": ParamSpec(
+        "definition path spawned peers load (absent = this "
+        "pipeline's definition, controller/gateway stripped)"),
+    "canary_watch_ticks": ParamSpec(
+        "controller ticks a swapped replica's SLO burn is watched "
+        "before the next replica swaps", number=True, minimum=1),
+    "canary_burn_ratio": ParamSpec(
+        "burn multiple over the pre-swap baseline that rolls a "
+        "canary-gated version swap back", number=True, minimum=1),
 }
 
 
@@ -363,6 +404,17 @@ def _check_value(name: str, spec: ParamSpec, value, spot: str) \
         problem = qos_spec_error(value)
         if problem is not None:
             return Finding("bad-parameter", f"qos: {problem}", spot)
+    if spec.kind == "json" and name == "controller" \
+            and value is not None:
+        # Fleet controller block (ISSUE 20): same jax-free twin the
+        # runtime parse uses, so a typo'd guardrail knob fails at
+        # create time -- not as a controller that silently never
+        # guards.
+        from ..orchestration.controller import controller_spec_error
+        problem = controller_spec_error(value)
+        if problem is not None:
+            return Finding("bad-parameter", f"controller: {problem}",
+                           spot)
     if spec.kind == "json" and name == "slo" and value is not None:
         # Per-tenant SLO objectives (ISSUE 19): same jax-free twin the
         # runtime uses (gateway/qos.py slo_spec_error) -- a malformed

@@ -862,11 +862,16 @@ def video_to_images_cmd(video, pattern):
               help="pipeline processes to spawn (>= 2 so adoption has "
                    "a survivor)")
 @click.option("--frames", default=12, help="frames the session streams")
-@click.option("--mode", type=click.Choice(["kill", "rolling"]),
+@click.option("--mode",
+              type=click.Choice(["kill", "rolling", "controller"]),
               default="kill",
               help="kill: SIGKILL one pipeline mid-stream and assert "
-                   "adoption; rolling: drain+respawn every pipeline "
-                   "in sequence and assert zero drops")
+                   "adoption + supervised respawn; rolling: "
+                   "drain+respawn every pipeline in sequence and "
+                   "assert zero drops; controller: overload a pilot "
+                   "running the fleet controller until it scales out, "
+                   "SIGKILL the spawned peer mid-stream, and assert "
+                   "respawn + zero-drop convergence")
 @click.option("--hang-ms", default=0.0,
               help="SIGSTOP the victim this long before the kill "
                    "(process_hang, kill mode only)")
@@ -887,6 +892,143 @@ def chaos(pipelines, frames, mode, hang_ms, busy_ms, timeout):
     if not result.get("ok"):
         raise click.ClickException(f"chaos walk failed: {result}")
     click.echo("chaos walk passed")
+
+
+# -- fleetctl (ISSUE 20: guarded elastic fleet controller) ------------------
+
+def _fleetctl_request(name, transport, timeout, command, arguments):
+    """Publish one ``(fleetctl <response_topic> <command> ...)`` to
+    the named pipeline and return its JSON report (do_request
+    pattern)."""
+    from .pipeline import PROTOCOL_PIPELINE
+    from .services import ServiceFilter, do_request
+
+    runtime = _runtime(transport)
+    reports = []
+
+    def request(proxy, response_topic):
+        proxy.fleetctl(response_topic, command, *arguments)
+
+    def response(items):
+        for reply_command, parameters in items:
+            if reply_command == "fleetctl" and parameters:
+                try:
+                    reports.append(json.loads(str(parameters[0])))
+                except ValueError:
+                    reports.append({"raw": str(parameters[0])})
+
+    do_request(runtime, None,
+               ServiceFilter(name=name, protocol=PROTOCOL_PIPELINE),
+               request, response)
+    runtime.run(until=lambda: bool(reports), timeout=timeout)
+    if not reports:
+        click.echo(f"no fleetctl reply from pipeline {name!r} "
+                   f"(not found, or not answering?)", err=True)
+        sys.exit(1)
+    report = reports[0]
+    if isinstance(report, dict) and report.get("error"):
+        raise click.ClickException(report["error"])
+    return report
+
+
+@main.group()
+def fleetctl():
+    """Operate a live fleet controller (``controller:`` pipelines):
+    inspect its decision surface, pause/resume the loop, or force one
+    guarded action."""
+
+
+@fleetctl.command("status")
+@click.argument("name")
+@_transport_option
+@click.option("--timeout", default=5.0, help="discovery wait seconds")
+def fleetctl_status(name, transport, timeout):
+    """Show the named pipeline's controller status: mode, fleet size,
+    budget left, last decision, supervisor roster."""
+    report = _fleetctl_request(name, transport, timeout, "status", ())
+    click.echo(json.dumps(report, indent=2, default=str))
+
+
+@fleetctl.command("pause")
+@click.argument("name")
+@_transport_option
+@click.option("--timeout", default=5.0, help="discovery wait seconds")
+def fleetctl_pause(name, transport, timeout):
+    """Pause the control loop (the fleet keeps serving as tuned)."""
+    report = _fleetctl_request(name, transport, timeout, "pause", ())
+    click.echo(f"controller paused "
+               f"(fleet_size={report.get('status', {}).get('fleet_size')})")
+
+
+@fleetctl.command("resume")
+@click.argument("name")
+@_transport_option
+@click.option("--timeout", default=5.0, help="discovery wait seconds")
+def fleetctl_resume(name, transport, timeout):
+    """Resume a paused control loop."""
+    report = _fleetctl_request(name, transport, timeout, "resume", ())
+    click.echo(f"controller resumed "
+               f"(fleet_size={report.get('status', {}).get('fleet_size')})")
+
+
+@fleetctl.command("force-action")
+@click.argument("name")
+@click.argument("kind")
+@_transport_option
+@click.option("--detail", default=None,
+              help='action detail as JSON, e.g. \'{"to": 4}\'')
+@click.option("--yes", is_flag=True,
+              help="skip the confirmation prompt")
+@click.option("--timeout", default=5.0, help="discovery wait seconds")
+def fleetctl_force(name, transport, kind, detail, yes, timeout):
+    """Force ONE action now (stage_inflight | device_inflight |
+    replicas | admit | spawn | retire | swap | rollback), bypassing
+    hysteresis and cooldown -- the budget, the fence, and observe
+    mode still apply."""
+    if detail is not None:
+        try:
+            json.loads(detail)
+        except ValueError as error:
+            raise click.BadParameter(f"--detail is not JSON: {error}")
+    if not yes:
+        click.confirm(f"force {kind!r} on pipeline {name!r} "
+                      f"(bypasses hysteresis + cooldown)?", abort=True)
+    arguments = (kind,) if detail is None else (kind, detail)
+    report = _fleetctl_request(name, transport, timeout, "force",
+                               arguments)
+    refused = report.get("refused")
+    if refused:
+        raise click.ClickException(f"refused: {refused}")
+    click.echo(f"forced {kind}: done "
+               f"(actions={report.get('status', {}).get('actions')})")
+
+
+@fleetctl.command("swap")
+@click.argument("name")
+@click.argument("stage")
+@click.argument("parameter")
+@click.argument("value")
+@_transport_option
+@click.option("--yes", is_flag=True,
+              help="skip the confirmation prompt")
+@click.option("--timeout", default=5.0, help="discovery wait seconds")
+def fleetctl_swap(name, transport, stage, parameter, value, yes,
+                  timeout):
+    """Begin a canary-gated replica-by-replica swap of one element
+    parameter (the "model version" knob) on STAGE.  VALUE is JSON
+    (bare strings pass through).  Burn above the canary ratio rolls
+    every swapped replica back automatically."""
+    if not yes:
+        click.confirm(f"swap {stage}.{parameter}={value!r} on "
+                      f"{name!r} replica-by-replica (canary-gated)?",
+                      abort=True)
+    report = _fleetctl_request(name, transport, timeout, "swap",
+                               (stage, parameter, value))
+    refused = report.get("refused")
+    if refused:
+        raise click.ClickException(f"refused: {refused}")
+    click.echo(f"swap of {stage}.{parameter} begun "
+               f"(watch: fleetctl status {name})")
 
 
 # -- broker -----------------------------------------------------------------

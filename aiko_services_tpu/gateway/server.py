@@ -194,6 +194,13 @@ class _Session:
         # the session window (only admitted frames enter); the pump
         # pops each entry when its result is delivered (or deduped).
         self.trace_pending: dict[int, tuple] = {}
+        # Retransmit line: frame_id -> (data, trace) for every frame
+        # dispatched but not yet answered.  Journal adoption replays
+        # what the dead pipeline INGESTED; a frame still in wire
+        # transit at the kill was never journaled anywhere, so the
+        # gateway -- the only party that still holds it -- re-fires
+        # its copy after re-bind.  Bounded by the session window.
+        self.unanswered: dict[int, tuple] = {}
 
     def next_frame_id(self) -> int:
         with self.state_lock:
@@ -276,6 +283,11 @@ class GatewayServer:
         self._pending_failovers: list[tuple] = []
         self.failovers = 0
         self.sessions_reaped = 0
+        # Fleet-controller routing (ISSUE 20): when the controller
+        # scales the process pool, new sessions spread least-loaded
+        # across home + peers instead of always binding home -- this
+        # is how a freshly spawned peer takes load.
+        self.balance = False
         # Observability plane (ISSUE 19): a standalone gateway owns its
         # registry + trace buffer; with a pipeline in-process both
         # delegate to its telemetry so gateway spans and pipeline spans
@@ -366,12 +378,27 @@ class GatewayServer:
     def _pick_target(self) -> "str | None":
         """Binding for a NEW session: the in-process pipeline when it
         is alive, else any discovered peer, else the empty sentinel
-        (no backend -- the open is refused)."""
-        if self._home_alive():
-            return None
+        (no backend -- the open is refused).  Under ``balance`` (the
+        fleet controller runs a process pool) the session goes to the
+        least-loaded target across home + peers, home winning ties."""
+        home = self._home_alive()
         with self._peers_lock:
-            for topic in self._peers:
-                return topic
+            peers = list(self._peers)
+        if self.balance and peers:
+            counts: dict = {peer: 0 for peer in peers}
+            if home:
+                counts[None] = 0
+            for session in list(self.sessions.values()):
+                if session.target in counts:
+                    counts[session.target] += 1
+            if counts:
+                return min(counts, key=lambda target:
+                           (counts[target], target is not None,
+                            target or ""))
+        if home:
+            return None
+        for topic in peers:
+            return topic
         return ""
 
     def _on_peer_lost(self, record, proxy=None) -> None:
@@ -441,12 +468,33 @@ class GatewayServer:
         # the journal replay lands before any new frame the re-bound
         # sessions send it.
         self._send_adopt(survivor, dead_name)
+        refired = 0
         for session in affected:
             session.target = None if survivor == "" else survivor
+            # Re-fire the session's unanswered frames at the new
+            # target.  Adoption only replays frames the dead pipeline
+            # journaled; one still in wire transit at the kill never
+            # reached any journal, and without this re-send it is
+            # simply gone -- the client stalls a window slot forever.
+            # Frames the adopter DOES replay arrive first (same FIFO
+            # mailbox), so our duplicate re-ingests into a silently
+            # skipped slot and delivery dedupe keeps the client's
+            # exactly-once, in-order contract.
+            with session.state_lock:
+                unanswered = sorted(session.unanswered.items())
+            for frame_id, (data, trace) in unanswered:
+                self._dispatch_frame(session, data, frame_id,
+                                     trace=trace)
+                refired += 1
+        if refired:
+            registry = self._registry()
+            if registry is not None:
+                registry.count("gateway_refired_frames", refired)
         _logger.warning(
             "gateway: pipeline %s died; %d session(s) re-bound to %s "
-            "(journal adoption requested)", dead_name, len(affected),
-            "local pipeline" if survivor == "" else survivor)
+            "(journal adoption requested, %d in-flight frame(s) "
+            "re-fired)", dead_name, len(affected), "local pipeline"
+            if survivor == "" else survivor, refired)
 
     def _send_adopt(self, survivor: str, dead_name: str) -> None:
         if survivor == "" and self.pipeline is not None:
@@ -491,6 +539,8 @@ class GatewayServer:
         holds across failovers regardless of which pipeline answers."""
         trace_id = trace[0] if trace else None
         trace_parent = trace[1] if trace else None
+        with session.state_lock:
+            session.unanswered[frame_id] = (data, trace)
         if session.target is None and self.pipeline is not None:
             self.pipeline.process_frame_local(
                 data, stream_id=session.stream_id,
@@ -1286,6 +1336,7 @@ class GatewayServer:
                 frame_seq = None
             if frame_seq is not None:
                 with session.state_lock:
+                    session.unanswered.pop(frame_seq, None)
                     if frame_seq <= session.last_delivered:
                         # Failover dedupe: the dead pipeline answered
                         # this frame before dying (or the journal's

@@ -63,6 +63,16 @@ class ProcessManager:
         return self.spawn(id, sys.executable, ["-m", module]
                           + [str(a) for a in (arguments or [])], **kwargs)
 
+    def adopt(self, id, process: subprocess.Popen) -> subprocess.Popen:
+        """Track an externally created Popen (FleetSupervisor spawns
+        through its injectable ``spawner``): same polling, same exit
+        handler as a spawn of our own."""
+        with self._lock:
+            self.processes[id] = process
+            self._commands[id] = list(getattr(process, "args", []) or [])
+        self._ensure_polling()
+        return process
+
     # -- destruction -------------------------------------------------------
 
     def destroy(self, id, kill_signal=signal.SIGTERM,

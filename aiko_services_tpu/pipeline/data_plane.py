@@ -214,15 +214,23 @@ class TensorPipeEndpoint:
     def watch(self, token_id: str, keys, callback) -> None:
         """Fire ``callback`` (from the collector thread; use post_self)
         once every key arrived -- or at the claim timeout, whichever is
-        first.  A token already complete fires inline."""
+        first.  A token already complete fires inline.  A CLOSED
+        endpoint fires the timeout path inline too: its collector
+        thread is gone, so no deadline would ever be serviced and the
+        deferred envelope (plus everything ordered behind it) would
+        hang forever instead of taking the counted MQTT re-forward."""
         with self._lock:
-            token = self._tokens.get(str(token_id))
-            complete = token is not None \
-                and set(keys) <= set(token.arrays)
-            if not complete:
-                self._watches[str(token_id)] = (
-                    frozenset(str(key) for key in keys), callback,
-                    time.monotonic() + self.claim_timeout_s)
+            if self._closing.is_set():
+                self.claims_expired += 1
+                complete = True          # fire below, outside the lock
+            else:
+                token = self._tokens.get(str(token_id))
+                complete = token is not None \
+                    and set(keys) <= set(token.arrays)
+                if not complete:
+                    self._watches[str(token_id)] = (
+                        frozenset(str(key) for key in keys), callback,
+                        time.monotonic() + self.claim_timeout_s)
         if complete:
             callback()
 
@@ -245,11 +253,25 @@ class TensorPipeEndpoint:
                     "dropped_frames": self.server.dropped}
 
     def close(self) -> None:
-        self._closing.set()
+        # _closing is set UNDER the lock so a racing watch() either
+        # registers before the drain below (and is fired here) or sees
+        # the flag and fires inline -- never a watch stranded on a dead
+        # collector.
+        with self._lock:
+            self._closing.set()
+            pending = [watch[1] for watch in self._watches.values()]
+            self._watches.clear()
+            self.claims_expired += len(pending)
         # join=False: teardown over many pipelines must not pay a
         # thread-join timeout per endpoint; the daemon threads exit on
         # their next poll tick.
         self.server.close(join=False)
+        for callback in pending:
+            try:
+                callback()
+            except Exception:
+                _logger.exception("data plane watch callback failed "
+                                  "during endpoint close")
 
 
 class PipeSender:

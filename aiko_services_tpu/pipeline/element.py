@@ -137,9 +137,18 @@ class PipelineElement:
 
     def get_parameter(self, name: str, default=None,
                       use_pipeline: bool = True):
-        """Returns (value, found).  Resolution order: stream parameters
-        (qualified ``Element.name`` first, then bare) -> element definition
-        -> pipeline parameters."""
+        """Returns (value, found).  Resolution order: per-replica
+        override (the fleet controller's canary-gated version swap,
+        ISSUE 20 -- only while a stage worker runs a specific replica)
+        -> stream parameters (qualified ``Element.name`` first, then
+        bare) -> element definition -> pipeline parameters."""
+        replica = self.pipeline.current_replica() \
+            if hasattr(self.pipeline, "current_replica") else None
+        if replica is not None and replica[0] == self.name:
+            value, found = self.pipeline.replica_override(
+                self.name, replica[1], name)
+            if found:
+                return value, True
         stream = self.pipeline.current_stream()
         if stream is not None:
             qualified = f"{self.name}.{name}"

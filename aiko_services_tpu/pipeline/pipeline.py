@@ -332,6 +332,11 @@ class Pipeline(Actor):
         # not let a LATER complete frame overtake it (see
         # _claim_for_ingest).
         self._pipe_ingest_wait: dict[str, list] = {}
+        # Claim-dropped frames awaiting their MQTT re-forward: stream
+        # key -> frame_id.  The ingest hold persists until the
+        # re-forward arrives (or its deadline passes) so frames held
+        # behind the dropped one cannot overtake its re-execution.
+        self._pipe_retry_wait: dict[str, object] = {}
         self._plane_counts = {"pipe_frames": 0, "pipe_bytes": 0,
                               "mqtt_frames": 0, "mqtt_bytes": 0,
                               "fallbacks": 0, "claims_dropped": 0}
@@ -406,6 +411,31 @@ class Pipeline(Actor):
             self._qos_sheds = 0
             self.share["qos_promotions"] = 0
             self.share["qos_sheds"] = 0
+            # Guarded elastic fleet controller (ISSUE 20): the spec is
+            # validated here -- same jax-free twin pre-flight's
+            # bad-parameter rule runs -- so ``preflight: off`` cannot
+            # smuggle a malformed block past create (the qos/slo/mesh
+            # discipline).  Construction happens after the timers
+            # below; parsing first keeps the failure create-time.
+            from ..orchestration.controller import ControllerSpec
+            try:
+                self._controller_spec = ControllerSpec.parse(
+                    definition.parameters.get("controller"),
+                    definition.parameters)
+            except (ValueError, TypeError) as error:
+                raise DefinitionError(
+                    f"pipeline {definition.name!r}: {error}")
+            self.controller = None
+            self._controller_timer = None
+            self.share["controller_actions"] = 0
+            self.share["controller_refusals"] = 0
+            self.share["canary_rollbacks"] = 0
+            self.share["fleet_size"] = 1
+            # Per-replica element-parameter overrides (the controller's
+            # canary-gated version swap): stage -> replica -> {name:
+            # value}, consulted by ``PipelineElement.get_parameter``
+            # through ``replica_override`` while a stage worker runs.
+            self._replica_overrides: dict[str, dict[int, dict]] = {}
             # Replicated stages (ISSUE 7): stage -> (min, max) autoscale
             # bounds resolved from the placement blocks' ``replicas`` specs
             # (int N -> (N, N); "auto" -> (1, pool); {min, max} as given).
@@ -552,6 +582,42 @@ class Pipeline(Actor):
                 self._autoscale_timer = self.runtime.engine.add_timer_handler(
                     self.autoscale_replicas, float(autoscale))
 
+            # Fleet controller construction (ISSUE 20; spec parsed and
+            # validated above).  The tick rides a GUARDED engine timer:
+            # a controller bug pauses the controller, never the
+            # pipeline -- and with the timer gone the fleet keeps
+            # serving exactly as last tuned (do-no-harm).
+            if self._controller_spec.mode != "off":
+                from ..orchestration.controller import (
+                    FleetController, FleetSupervisor, default_spawner)
+                supervisor = None
+                if self._controller_spec.fleet_max > 1 \
+                        and self._controller_spec.mode == "act":
+                    # Peers load fleet_definition when given, else a
+                    # stripped copy of THIS definition (controller/
+                    # gateway off, same journal_dir = adoptable).
+                    spawn_definition = definition
+                    if self._controller_spec.fleet_definition:
+                        spawn_definition = load_pipeline_definition(
+                            self._controller_spec.fleet_definition)
+                    supervisor = FleetSupervisor(
+                        default_spawner(
+                            spawn_definition,
+                            str(definition.parameters.get(
+                                "journal_dir") or "")),
+                        engine=self.runtime.engine)
+                self.controller = FleetController(
+                    self, self._controller_spec,
+                    supervisor=supervisor)
+                if supervisor is not None and self.gateway is not None:
+                    # Spawned peers must TAKE load: new sessions
+                    # spread least-loaded across home + peers.
+                    self.gateway.balance = True
+                self._controller_timer = \
+                    self.runtime.engine.add_timer_handler(
+                        self._controller_tick,
+                        self._controller_spec.interval_ms / 1000.0)
+
             fault_plan = definition.parameters.get("fault_plan")
             if fault_plan:
                 self.arm_faults(fault_plan)
@@ -566,6 +632,10 @@ class Pipeline(Actor):
             if fleet is not None:
                 fleet.stop()
                 self.fleet_collector = None
+            controller = getattr(self, "controller", None)
+            if controller is not None \
+                    and controller.supervisor is not None:
+                controller.supervisor.stop_all()
             if self.metrics_server is not None:
                 self.metrics_server.stop()
                 self.metrics_server = None
@@ -739,6 +809,10 @@ class Pipeline(Actor):
             self.runtime.engine.remove_timer_handler(
                 self._autoscale_timer)
             self._autoscale_timer = None
+        if getattr(self, "_controller_timer", None) is not None:
+            self.runtime.engine.remove_timer_handler(
+                self._controller_timer)
+            self._controller_timer = None
 
     def check_device_health(self, prober=None, timeout=None,
                             devices=None) -> list:
@@ -1178,6 +1252,166 @@ class Pipeline(Actor):
                                               stage=stage)
         return decisions
 
+    # -- fleet-controller actuator seams (ISSUE 20) ------------------------
+
+    def _controller_tick(self) -> None:
+        """Guarded controller tick: a controller bug pauses the
+        controller and cancels its timer -- the pipeline, its streams
+        and every supervised peer keep serving as last tuned
+        (controller-death-safe by construction)."""
+        controller = self.controller
+        if controller is None:
+            return
+        try:
+            controller.tick()
+        except Exception:
+            self.logger.exception(
+                "fleet controller tick raised; controller paused, "
+                "fleet keeps serving as tuned")
+            controller.paused = True
+            if self._controller_timer is not None:
+                self.runtime.engine.remove_timer_handler(
+                    self._controller_timer)
+                self._controller_timer = None
+
+    def set_stage_inflight(self, depth) -> bool:
+        """Live re-tune of the per-stage admission window (controller
+        actuator; callable by operators via ``set_parameter``-style
+        wire commands too).  Deepening wakes queued waiters into the
+        new credits immediately; shrinking drains naturally.  Returns
+        whether anything changed."""
+        scheduler = self.stage_scheduler
+        depth = max(1, int(parse_number(depth, 0)))
+        if scheduler is None or depth == scheduler.depth:
+            return False
+        previous = scheduler.depth
+        scheduler.set_depth(depth)
+        self._pipeline_parameters["stage_inflight"] = depth
+        if depth > previous:
+            for stage in scheduler.stages:
+                self._pump_stage(stage)
+        self.logger.info("stage_inflight: %d -> %d", previous, depth)
+        return True
+
+    def set_device_inflight(self, depth) -> bool:
+        """Live re-tune of the async-dispatch overlap window.  Applies
+        to the pipeline default AND every live stream that did not
+        pin its own ``device_inflight`` stream parameter (a stream's
+        explicit choice outlives the controller's)."""
+        depth = max(0, int(parse_number(depth, 0)))
+        current = int(parse_number(
+            self.get_pipeline_parameter("device_inflight"),
+            DEVICE_INFLIGHT_DEFAULT))
+        if depth == current:
+            return False
+        self._pipeline_parameters["device_inflight"] = depth
+        for stream in self.streams.values():
+            if "device_inflight" not in stream.parameters:
+                stream.device_inflight = depth
+        self.logger.info("device_inflight: %d -> %d", current, depth)
+        return True
+
+    def swap_replica_version(self, stage, index, name, value,
+                             canary: bool = True):
+        """Set (or with ``value=None`` clear) a per-replica override
+        of one element parameter -- the controller's canary-gated
+        "model version" swap unit.  With ``canary`` the replica is
+        demoted to half-open so its next admission is a single canary
+        frame (ISSUE 7 lifecycle decides live-or-dead from that
+        frame); rollback passes ``canary=False`` to restore known-good
+        capacity immediately.  Returns the PREVIOUS override (None =
+        none -- round-trips through rollback naturally)."""
+        stage, index = str(stage), int(index)
+        overrides = self._replica_overrides.setdefault(
+            stage, {}).setdefault(index, {})
+        old = overrides.get(name)
+        if value is None:
+            overrides.pop(name, None)
+        else:
+            overrides[name] = value
+        scheduler = self.stage_scheduler
+        group = None if scheduler is None \
+            else scheduler.groups.get(stage)
+        if canary and group is not None:
+            group.reopen(index)
+        self._rec("version_swap", None, None, stage, None,
+                  {"replica": index, "parameter": str(name),
+                   "canary": bool(canary),
+                   "cleared": value is None})
+        return old
+
+    def fleetctl(self, response_topic, command, *arguments):
+        """Wire-invocable fleet-controller control surface (``python
+        -m aiko_services_tpu fleetctl`` publishes ``(fleetctl
+        <response_topic> <command> ...)`` to our in-topic): replies on
+        ``response_topic`` with the do_request pattern -- one
+        ``(item_count 1)`` then one ``(fleetctl <json report>)``.
+        Commands: ``status`` / ``pause`` / ``resume`` / ``force KIND
+        [detail-json]`` / ``swap STAGE PARAMETER VALUE-JSON``."""
+        import json
+
+        from ..utils import generate
+        command = str(command)
+        controller = self.controller
+        if controller is None:
+            report = {"error": "no fleet controller on this pipeline "
+                               "(controller: off)"}
+        elif command == "status":
+            report = controller.status()
+        elif command == "pause":
+            controller.pause()
+            report = {"paused": True, "status": controller.status()}
+        elif command == "resume":
+            controller.resume()
+            report = {"paused": False, "status": controller.status()}
+        elif command == "force":
+            kind = str(arguments[0]) if arguments else ""
+            detail = {}
+            if len(arguments) > 1:
+                try:
+                    detail = dict(json.loads(str(arguments[1])))
+                except (ValueError, TypeError) as error:
+                    detail = None
+                    report = {"error": f"bad detail JSON: {error}"}
+            if detail is not None:
+                problem = controller.force_action(kind, **detail)
+                report = {"forced": kind, "refused": problem,
+                          "status": controller.status()}
+        elif command == "swap":
+            if len(arguments) < 3:
+                report = {"error": "swap needs STAGE PARAMETER VALUE"}
+            else:
+                try:
+                    value = json.loads(str(arguments[2]))
+                except ValueError:
+                    value = str(arguments[2])
+                problem = controller.begin_swap(
+                    str(arguments[0]), str(arguments[1]), value)
+                report = {"swap": str(arguments[0]),
+                          "refused": problem,
+                          "status": controller.status()}
+        else:
+            report = {"error": f"unknown fleetctl command "
+                               f"{command!r} (status|pause|resume|"
+                               f"force|swap)"}
+        publish = self.runtime.message.publish
+        publish(str(response_topic), generate("item_count", [1]))
+        publish(str(response_topic),
+                generate("fleetctl", [json.dumps(report,
+                                                 default=str)]))
+
+    def replica_override(self, stage, index, name):
+        """(value, found) for a per-replica parameter override --
+        consulted by ``PipelineElement.get_parameter`` ahead of every
+        other source while a stage worker runs replica ``index``."""
+        overrides = self._replica_overrides.get(str(stage))
+        if not overrides:
+            return None, False
+        values = overrides.get(int(index))
+        if not values or name not in values:
+            return None, False
+        return values[name], True
+
     def replica_stats(self) -> dict:
         """Per-replicated-stage view the dashboard/bench read: slot
         states, per-replica in-flight + occupancy, live count, bounds,
@@ -1467,6 +1701,20 @@ class Pipeline(Actor):
         waiting = self._pipe_ingest_wait.get(stream_key)
         token = stream_dict.get("pipe_token")
         if waiting is not None:
+            retry_id = self._pipe_retry_wait.get(stream_key)
+            if retry_id is not None and not token \
+                    and str(stream_dict.get("frame_id")) == str(retry_id):
+                # The awaited MQTT re-forward of the claim-dropped
+                # head: ingest it NOW, then release the envelopes held
+                # behind it in arrival order (posted, so they ingest
+                # after this frame).
+                del self._pipe_retry_wait[stream_key]
+                for held_dict, held_data in \
+                        self._pipe_ingest_wait.pop(stream_key, None) \
+                        or []:
+                    self.post_self("process_frame",
+                                   [held_dict, held_data])
+                return {}
             # An earlier frame of this stream is still waiting for its
             # tensors: hold THIS envelope (tokened or not) behind it.
             waiting.append((stream_dict, frame_data))
@@ -1501,6 +1749,19 @@ class Pipeline(Actor):
                 self.runtime.message.publish(
                     response_topic,
                     generate("process_frame_response", [header, {}]))
+                # The origin will re-forward this frame over MQTT:
+                # keep the stream's ingest hold until it lands, else
+                # complete frames held behind this one would overtake
+                # the re-execution.  Deadline-bounded -- an origin
+                # that never re-forwards (died, retry budget spent)
+                # must not wedge the stream.
+                frame_id = stream_dict.get("frame_id")
+                self._pipe_ingest_wait.setdefault(stream_key, [])
+                self._pipe_retry_wait[stream_key] = frame_id
+                self.runtime.engine.add_oneshot_timer(
+                    lambda: self._pipe_retry_expired(stream_key,
+                                                     frame_id),
+                    max(1.0, endpoint.claim_timeout_s))
             return None
         stream_dict["pipe_deferred"] = True
         self._pipe_ingest_wait[stream_key] = []
@@ -1510,6 +1771,18 @@ class Pipeline(Actor):
                                    [stream_key, stream_dict,
                                     frame_data]))
         return None
+
+    def _pipe_retry_expired(self, stream_key, frame_id) -> None:
+        """Deadline for a requested MQTT re-forward that never arrived
+        (origin died, retry budget spent): release the ingest hold so
+        the stream keeps serving -- the dropped frame belongs to the
+        origin's deadline/breaker machinery now."""
+        if self._pipe_retry_wait.get(str(stream_key)) != frame_id:
+            return
+        del self._pipe_retry_wait[str(stream_key)]
+        held = self._pipe_ingest_wait.pop(str(stream_key), None) or []
+        for held_dict, held_data in held:
+            self.process_frame(held_dict, held_data)
 
     def ingest_pipe_ready(self, stream_key, stream_dict, frame_data):
         """Continuation: the head waiting frame's pipe tensors arrived
@@ -2153,6 +2426,10 @@ class Pipeline(Actor):
                 "slo_burn",
                 detail=f"tenant {tenant} class {qos_class} "
                        f"burn {float(burn):.2f}x")
+        if fired and self.controller is not None:
+            # The controller's spawn tier keys urgency off fast burns
+            # (burn_rates alone lags by the SLO window).
+            self.controller.note_burns(fired)
 
     def _stamp_deadline(self, stream: Stream, frame: Frame) -> None:
         if not stream.deadline_ms:
@@ -4813,8 +5090,13 @@ class Pipeline(Actor):
         thread.start()
 
     def stop(self):
-        self._cancel_health_timer()
+        self._cancel_health_timer()     # controller timer included
         self.disarm_faults()
+        controller = getattr(self, "controller", None)
+        if controller is not None:
+            if controller.supervisor is not None:
+                controller.supervisor.stop_all()
+            self.controller = None
         fleet = getattr(self, "fleet_collector", None)
         if fleet is not None:
             fleet.stop()

@@ -289,6 +289,27 @@ class ReplicaGroup:
             self.transitions.append(
                 (index, self.states[index], time.monotonic()))
 
+    def set_depth(self, depth: int) -> None:
+        """Live re-tune of the per-replica credit window (the fleet
+        controller's queue-dominated actuator, ISSUE 20).  Shrinking
+        never yanks an in-flight frame -- admission just stalls until
+        the slot drains below the new window."""
+        self.depth = max(1, int(depth))
+
+    def reopen(self, index: int) -> bool:
+        """Demote a LIVE slot back to half-open so its next admission
+        is a single canary frame (the controller's canary-gated
+        version swap, ISSUE 20: swap the element parameter, then prove
+        the new version on one frame before full re-admission).  Dead
+        and already-half-open slots are left alone; returns whether
+        the transition happened."""
+        if index >= len(self.states) \
+                or self.states[index] != REPLICA_LIVE:
+            return False
+        self.canary_inflight[index] = False
+        self._transition(index, REPLICA_HALF_OPEN)
+        return True
+
     def live(self) -> int:
         return sum(1 for state in self.states if state == REPLICA_LIVE)
 
@@ -391,6 +412,16 @@ class StageScheduler:
         return worker
 
     # -- admission window --------------------------------------------------
+
+    def set_depth(self, depth: int) -> None:
+        """Live re-tune of the stage credit window (fleet controller,
+        ISSUE 20).  Growing frees credits immediately -- the caller
+        must walk ``_pump_stage`` to wake queued waiters into them;
+        shrinking stops admitting until in-flight frames drain below
+        the new window (nothing is yanked)."""
+        self.depth = max(1, int(depth))
+        for group in self.groups.values():
+            group.set_depth(self.depth)
 
     def try_admit(self, stage: str, reserved: bool = False) -> bool:
         """``reserved`` marks the admission attempt of a popped waiter

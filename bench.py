@@ -2562,6 +2562,7 @@ def bench_pipeline_gateway() -> dict:
         ])
         promotions = pipeline.share.get("qos_promotions", 0)
         result["gateway_qos_promotions"] = promotions
+        result["gateway_promotions_fired"] = bool(promotions > 0)
         if promotions == 0:
             result["pipeline_gateway_error"] = \
                 "qos_promotions stayed 0 across the near-deadline " \
@@ -2822,6 +2823,390 @@ def bench_pipeline_failover() -> dict:
     for key in ("pipeline_journal_fps", "pipeline_nojournal_fps",
                 "pipeline_failover_mttr_ms",
                 "failover_rolling_p99_ms"):
+        prior = previous.get(key)
+        if prior and result.get(key):
+            result[f"{key}_vs_baseline"] = round(result[key] / prior,
+                                                 2)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# 4g. Guarded elastic fleet controller (ISSUE 20): knob convergence
+#     from a deliberately mis-tuned config (the controller must tune a
+#     live pipeline to >= 90% of the hand-tuned fps), then the
+#     multi-process 1->3->1 ramp -- scale-out under burning SLO, a
+#     SIGKILL of a scaled-out peer absorbed by the supervised respawn
+#     path, zero dropped frames, scale-in when the load releases.
+
+CONTROLLER_STAGE_BUSY_MS = 6.0
+CONTROLLER_WINDOW_S = 1.2
+CONTROLLER_MAX_WINDOWS = 12
+CONTROLLER_TARGET_FRAC = 0.9
+CONTROLLER_RAMP_BUSY_MS = 30.0
+CONTROLLER_RAMP_SLO_MS = 5000.0
+
+
+def bench_pipeline_controller() -> dict:
+    import queue as queue_module
+    import threading
+    import time as time_module
+
+    import jax
+    import numpy as np
+
+    if len(jax.devices()) < 4:
+        return {"pipeline_controller_skipped":
+                f"needs >= 4 devices, have {len(jax.devices())}"}
+    from aiko_services_tpu.pipeline import Pipeline
+    from aiko_services_tpu.runtime import init_process, reset_process
+    from aiko_services_tpu.transport import reset_broker
+
+    payload = {"x": np.ones((64,), np.float32)}
+    result: dict = {}
+
+    # -- part A: knob convergence on a live in-process pipeline ----------
+    def build(runtime, extra):
+        return Pipeline(
+            {"version": 0, "name": "bench_ctl", "runtime": "jax",
+             "graph": ["(work finish)"],
+             "parameters": dict(extra),
+             "elements": [
+                 {**element("work", "StageWork", ["x"], ["x"],
+                            {"busy_ms": CONTROLLER_STAGE_BUSY_MS,
+                             "factor": 2.0}),
+                  "placement": {"devices": 2}},
+                 {**element("finish", "StageWork", ["x"], ["x"],
+                            {"busy_ms": CONTROLLER_STAGE_BUSY_MS,
+                             "factor": 3.0}),
+                  "placement": {"devices": 2}},
+             ]}, runtime=runtime)
+
+    def run_windows(extra, windows, stop_at=None):
+        """Open-loop pump (16 outstanding) measured in wall-clock
+        windows; returns (per-window fps, final share, status)."""
+        reset_broker()
+        reset_process()
+        runtime = init_process(transport="loopback")
+        runtime.initialize()
+        try:
+            pipeline = build(runtime, extra)
+            responses = queue_module.Queue()
+            pipeline.create_stream_local("s",
+                                         queue_response=responses)
+            state = {"sent": 0, "done": 0}
+
+            def pump(deadline):
+                def step():
+                    while not responses.empty():
+                        responses.get()
+                        state["done"] += 1
+                    while state["sent"] - state["done"] < 16:
+                        pipeline.process_frame_local(
+                            dict(payload), stream_id="s")
+                        state["sent"] += 1
+                    return time_module.perf_counter() > deadline
+                runtime.run(until=step, timeout=60.0)
+
+            pump(time_module.perf_counter() + 1.0)     # compile warm
+            rates = []
+            for _ in range(windows):
+                start = time_module.perf_counter()
+                before = state["done"]
+                pump(start + CONTROLLER_WINDOW_S)
+                elapsed = time_module.perf_counter() - start
+                rates.append((state["done"] - before) / elapsed)
+                if stop_at is not None and rates[-1] >= stop_at:
+                    break
+
+            def drained():
+                while not responses.empty():
+                    responses.get()
+                    state["done"] += 1
+                return state["done"] >= state["sent"]
+            runtime.run(until=drained, timeout=60.0)
+            controller = pipeline.controller
+            return (rates, dict(pipeline.share),
+                    controller.status() if controller else {})
+        finally:
+            runtime.terminate()
+
+    hand_rates, _, _ = run_windows(
+        {"stage_inflight": 4, "device_inflight": 3}, 2)
+    fps_hand = max(hand_rates)
+    mis_rates, _, _ = run_windows(
+        {"stage_inflight": 1, "device_inflight": 1}, 2)
+    fps_mistuned = max(mis_rates)
+    target = CONTROLLER_TARGET_FRAC * fps_hand
+    ctl_rates, share, status = run_windows(
+        {"stage_inflight": 1, "device_inflight": 1,
+         "controller": {"mode": "act", "interval_ms": 100,
+                        "hysteresis_ticks": 2, "cooldown_ms": 300,
+                        "action_budget": 16, "budget_window_s": 30}},
+        CONTROLLER_MAX_WINDOWS, stop_at=target)
+    fps_converged = max(ctl_rates)
+    result.update({
+        "controller_fps_hand_tuned": round(fps_hand, 2),
+        "controller_fps_mistuned": round(fps_mistuned, 2),
+        "controller_fps_converged": round(fps_converged, 2),
+        "controller_convergence_ratio": round(
+            fps_converged / fps_hand, 3),
+        "controller_converged": bool(fps_converged >= target),
+        "controller_convergence_windows": len(ctl_rates),
+        "controller_actions": share.get("controller_actions", 0),
+        "controller_refusals": status.get("refusals", 0),
+    })
+
+    # -- part B: 1 -> 3 -> 1 process ramp with kill-while-scaled ---------
+    import json as json_module
+    import signal as signal_module
+    import subprocess
+    import tempfile
+
+    from aiko_services_tpu.faults.chaos import (_peer_pids,
+                                                _pilot_definition)
+    from aiko_services_tpu.gateway.client import GatewayClient
+    from aiko_services_tpu.orchestration.controller import \
+        FleetSupervisor
+    from aiko_services_tpu.pipeline.pipeline import PROTOCOL_PIPELINE
+    from aiko_services_tpu.services import ServiceFilter, do_discovery
+
+    from aiko_services_tpu.transport.broker import BrokerProcess
+
+    workdir = tempfile.mkdtemp(prefix="aiko_bench_ctl_")
+    journal_dir = os.path.join(workdir, "journals")
+    os.makedirs(journal_dir, exist_ok=True)
+    pilot = "benchpilot"
+    definitions = {pilot: _pilot_definition(
+        pilot, journal_dir, busy_ms=CONTROLLER_RAMP_BUSY_MS,
+        fleet_max=3, cooldown_ms=800.0)}
+    broker = registrar = supervisor = runtime = discovery = None
+    deadline = time.monotonic() + 300.0
+    try:
+        reset_broker()
+        reset_process()
+        broker = BrokerProcess(port=0, export_env=True).start()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+        registrar_log = open(os.path.join(workdir, "registrar.log"),
+                             "w")
+        registrar = subprocess.Popen(
+            [sys.executable, "-m", "aiko_services_tpu", "registrar",
+             "-t", "mqtt"], env=env, stdout=registrar_log,
+            stderr=registrar_log, start_new_session=True)
+
+        def spawner(name):
+            path = os.path.join(workdir, f"{name}.json")
+            with open(path, "w") as stream:
+                json_module.dump(definitions[name], stream)
+            log = open(os.path.join(workdir, f"{name}.log"), "a")
+            return subprocess.Popen(
+                [sys.executable, "-m", "aiko_services_tpu",
+                 "pipeline", "create", path, "-t", "mqtt",
+                 "--name", name],
+                env=env, stdout=log, stderr=log,
+                start_new_session=True)
+
+        supervisor = FleetSupervisor(spawner, engine=None,
+                                     backoff_s=0.5)
+        runtime = init_process(transport="mqtt")
+        runtime.initialize()
+
+        peers: dict = {}                 # topic_path -> name
+        tags: dict = {}                  # name -> host:port
+        lock = threading.Lock()
+
+        def on_found(record, proxy):
+            with lock:
+                peers[record.topic_path] = record.name
+                for tag in record.tags:
+                    if tag.startswith("gateway="):
+                        tags[record.name] = tag.split("=", 1)[1]
+
+        def on_lost(record, proxy):
+            with lock:
+                peers.pop(record.topic_path, None)
+
+        discovery = do_discovery(
+            runtime, ServiceFilter(protocol=PROTOCOL_PIPELINE),
+            add_handler=on_found, remove_handler=on_lost)
+
+        def wait_for(predicate, what):
+            runtime.run(until=predicate,
+                        timeout=max(1.0,
+                                    deadline - time.monotonic()))
+            if not predicate():
+                raise RuntimeError(f"ramp: timed out waiting for "
+                                   f"{what} (see {workdir})")
+
+        def fleet_size():
+            with lock:
+                return len(set(peers.values()))
+
+        supervisor.spawn(pilot)
+        wait_for(lambda: pilot in tags, "pilot gateway tag")
+        host, _, port = tags[pilot].partition(":")
+
+        latencies: list = []
+        errors: list = []
+        release = threading.Event()
+        sessions: list = []
+
+        def drive(session_name, window):
+            """Open-loop pressure until released; per-frame e2e
+            latency from the in-order result stream."""
+            try:
+                client = GatewayClient(host, int(port),
+                                       timeout=120.0)
+                client.open(session=session_name)
+                stamps: list = []
+                delivered = []
+                for index in range(window):
+                    stamps.append(time_module.perf_counter())
+                    client.send_frame({"x": [float(index + 1)] * 4})
+                sent = window
+                while not release.is_set():
+                    entry = client.next_result(timeout=90.0)
+                    latencies.append(
+                        (time_module.perf_counter() - stamps.pop(0))
+                        * 1000.0)
+                    delivered.append(entry)
+                    stamps.append(time_module.perf_counter())
+                    client.send_frame({"x": [float(sent + 1)] * 4})
+                    sent += 1
+                while len(delivered) < sent:
+                    entry = client.next_result(timeout=90.0)
+                    latencies.append(
+                        (time_module.perf_counter() - stamps.pop(0))
+                        * 1000.0)
+                    delivered.append(entry)
+                client.close()
+                sessions.append((session_name, sent, delivered))
+            except Exception as error:
+                errors.append(f"{session_name}: "
+                              f"{type(error).__name__}: {error}")
+
+        ramp_start = time_module.perf_counter()
+        threads = [threading.Thread(target=drive,
+                                    args=(f"press{i}", 4),
+                                    daemon=True) for i in range(3)]
+        for thread in threads:
+            thread.start()
+
+        # Scale-out #1: burning SLO + overload spawns the first peer.
+        wait_for(lambda: fleet_size() >= 2 or errors,
+                 "first controller scale-out")
+        if errors:
+            raise RuntimeError(errors[0])
+        with lock:
+            first_peer = next(name for name in peers.values()
+                              if name != pilot)
+        # A probe session now binds to the idle peer (least-loaded
+        # balancing) -- the kill below lands under a live session.
+        probe = threading.Thread(target=drive, args=("probe", 2),
+                                 daemon=True)
+        threads.append(probe)
+        probe.start()
+
+        # Scale-out #2: pressure sessions stay bound to the pilot, so
+        # it keeps burning until the fleet hits fleet_max=3.
+        wait_for(lambda: fleet_size() >= 3 or errors,
+                 "fleet to reach 3")
+        if errors:
+            raise RuntimeError(errors[0])
+        result["controller_scaleout_s"] = round(
+            time_module.perf_counter() - ramp_start, 2)
+        result["controller_fleet_peak"] = fleet_size()
+
+        # Kill-while-scaled: SIGKILL the first peer (the probe's
+        # host); the pilot's supervisor must respawn it.
+        pids = _peer_pids(first_peer)
+        if not pids:
+            raise RuntimeError(f"no process found for {first_peer}")
+        os.kill(pids[0], signal_module.SIGKILL)
+        wait_for(lambda: any(name == first_peer
+                             for name in list(peers.values()))
+                 or errors, f"{first_peer} respawn")
+        if errors:
+            raise RuntimeError(errors[0])
+        result["controller_kill_absorbed"] = True
+
+        # Release: drain every session, then the controller must
+        # retire the idle peers back down to fleet_min=1.
+        hold = time_module.perf_counter() + 2.0
+        wait_for(lambda: time_module.perf_counter() > hold, "hold")
+        release.set()
+        wait_for(lambda: not any(thread.is_alive()
+                                 for thread in threads),
+                 "session completion")
+        if errors:
+            raise RuntimeError(errors[0])
+        scalein_start = time_module.perf_counter()
+        wait_for(lambda: fleet_size() <= 1, "scale-in back to 1")
+        result["controller_scalein_s"] = round(
+            time_module.perf_counter() - scalein_start, 2)
+
+        sent_total = sum(sent for _, sent, _ in sessions)
+        delivered_total = sum(len(delivered)
+                              for _, _, delivered in sessions)
+        in_order = all(
+            [entry["frame"] for entry in delivered]
+            == list(range(sent))
+            for _, sent, delivered in sessions)
+        all_ok = all(entry["ok"] for _, _, delivered in sessions
+                     for entry in delivered)
+        ordered = sorted(latencies)
+        p99 = ordered[int(len(ordered) * 0.99)] if ordered else None
+        result.update({
+            "controller_ramp_frames": sent_total,
+            "controller_ramp_dropped": sent_total - delivered_total,
+            "controller_ramp_in_order": bool(in_order),
+            "controller_ramp_all_ok": bool(all_ok),
+            "controller_ramp_p99_ms": round(p99, 2) if p99 else None,
+            "controller_ramp_slo_ms": CONTROLLER_RAMP_SLO_MS,
+            "controller_ramp_within_slo": bool(
+                p99 is not None and p99 <= CONTROLLER_RAMP_SLO_MS),
+            "controller_ramp_respawns": supervisor.respawns,
+            "controller_ramp_ok": bool(
+                in_order and all_ok
+                and sent_total == delivered_total
+                and result.get("controller_kill_absorbed")),
+        })
+    except Exception as error:
+        result["pipeline_controller_error"] = \
+            f"{type(error).__name__}: {error}"
+    finally:
+        if discovery is not None:
+            discovery.terminate()
+        if runtime is not None:
+            try:
+                runtime.terminate()
+            except Exception:
+                pass
+            reset_process()
+        if supervisor is not None:
+            supervisor.stop_all(5.0)
+        if registrar is not None:
+            if registrar.poll() is None:
+                registrar.terminate()
+            try:
+                registrar.wait(5.0)
+            except subprocess.TimeoutExpired:
+                registrar.kill()
+        for pid in _peer_pids("benchpilot-peer"):
+            try:
+                os.kill(pid, signal_module.SIGKILL)
+            except OSError:
+                pass
+        if broker is not None:
+            broker.stop()
+
+    previous = _previous_bench()
+    for key in ("controller_fps_converged",
+                "controller_convergence_ratio",
+                "controller_scaleout_s", "controller_scalein_s",
+                "controller_ramp_p99_ms"):
         prior = previous.get(key)
         if prior and result.get(key):
             result[f"{key}_vs_baseline"] = round(result[key] / prior,
@@ -3353,6 +3738,7 @@ def main() -> int:
             ("bench_pipeline_replicas", bench_pipeline_replicas),
             ("bench_pipeline_gateway", bench_pipeline_gateway),
             ("bench_pipeline_failover", bench_pipeline_failover),
+            ("bench_pipeline_controller", bench_pipeline_controller),
             ("bench_pipeline_fleet", bench_pipeline_fleet),
             ("bench_asr", lambda: bench_asr(rtt)),
             ("bench_speech_e2e", bench_speech_e2e)):

@@ -333,6 +333,64 @@ def test_ws_session_fails_over_on_kill(runtime, tmp_path):
     p2.stop()
 
 
+def test_refire_covers_wire_transit_loss_on_kill(runtime, tmp_path):
+    """A frame in wire transit at the kill reached NO journal --
+    adoption cannot replay it.  The gateway's retransmit line
+    (``_Session.unanswered``) must re-fire its own copy at the
+    survivor after the re-bind; before it existed these frames were
+    simply gone and the session stalled a window slot forever."""
+    Registrar(runtime=runtime, primary_search_timeout=0.05)
+    p1 = serving(runtime, "srv1", tmp_path, busy_ms=5.0)
+    gateway = GatewayServer(runtime=runtime)
+    run_until(runtime, lambda: len(gateway._peers) == 1)
+    p2 = serving(runtime, "srv2", tmp_path, busy_ms=5.0)
+    run_until(runtime, lambda: len(gateway._peers) == 2)
+    assert list(gateway._peers.values())[0] == "srv1"
+
+    client = GatewayClient("127.0.0.1", gateway.port, timeout=90.0)
+
+    def phase_send():
+        client.open(session="rf", tenant="t1")
+        for index in range(2):
+            client.send_frame({"x": [float(index + 1)] * 2})
+        return [client.next_result(), client.next_result()]
+
+    thread, box = in_thread(phase_send)
+    first = finish(runtime, thread, box)
+    assert [r["frame"] for r in first] == [0, 1]
+
+    p1.kill()               # handlers gone; failover not yet begun
+
+    def phase_transit():
+        # Dispatched at srv1's now-dead topic: dropped on the floor,
+        # past every journal's horizon.
+        client.send_frame({"x": [3.0] * 2})
+        client.send_frame({"x": [4.0] * 2})
+
+    thread, box = in_thread(phase_transit)
+    finish(runtime, thread, box)
+    # the dead pipeline never saw them: its crash-time journal holds
+    # only the two frames it delivered
+    entry = load_journal(tmp_path / "srv1.journal").streams["gw/rf"]
+    assert 2 not in entry.frames and 3 not in entry.frames
+
+    run_until(runtime, lambda: gateway.failovers == 1, timeout=10.0)
+
+    def phase_recv():
+        results = [client.next_result(timeout=60.0) for _ in range(2)]
+        client.close()
+        return results
+
+    thread, box = in_thread(phase_recv)
+    rest = finish(runtime, thread, box)
+    assert [r["frame"] for r in rest] == [2, 3]
+    for result, x in zip(rest, (3.0, 4.0)):
+        assert result["ok"], result
+        assert result["data"]["x"][0] == pytest.approx(6.0 * x)
+    gateway.stop()
+    p2.stop()
+
+
 def test_process_kill_fault_point_drives_failover(runtime, tmp_path):
     """The armed ``process_kill`` fault point IS the kill switch: the
     pipeline dies on the rule-matched ingest, deterministically."""

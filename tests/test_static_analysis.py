@@ -43,6 +43,7 @@ DEFINITION_FIXTURES = {
     "bad_drain_timeout.json": "bad-parameter",
     "bad_slo.json": "bad-parameter",
     "bad_fleet.json": "bad-parameter",
+    "bad_controller.json": "bad-parameter",
     "data_plane_on_local.json": "data-plane-on-local",
     "bad_source.py": "bad-source",
     "undeclared_host_input.json": "undeclared-host-input",
